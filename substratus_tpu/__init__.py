@@ -1,0 +1,25 @@
+"""substratus_tpu — a TPU-native ML orchestration + runtime framework.
+
+Re-implements the capability surface of substratusai/substratus (a Go
+Kubernetes operator, reference at /root/reference) TPU-first, and brings the
+ML runtime that the reference delegated to external container images
+(model-loader/trainer/server, SURVEY.md §2.2) in-repo as JAX/XLA/Pallas code.
+
+Layout (top of SURVEY.md §7):
+  api/         CR types: Dataset, Model, Notebook, Server (reference: api/v1)
+  controller/  reconcilers + controller runtime (reference: internal/controller)
+  kube/        minimal K8s REST client + in-memory fake apiserver (envtest)
+  cloud/       cloud abstraction: gcp, local (reference: internal/cloud)
+  sci/         storage/identity gRPC service (reference: internal/sci)
+  resources/   CR resources -> pod specs, TPU topology (internal/resources)
+  models/      JAX model zoo: llama family flagship
+  ops/         attention (XLA + Pallas flash/ring), quant, sampling
+  parallel/    mesh building, named shardings, collectives, distributed init
+  train/       pjit trainer: FSDP/TP, LoRA, orbax checkpointing
+  serve/       continuous-batching inference engine + OpenAI-compatible HTTP
+  load/        HF safetensors -> sharded jax params -> artifacts
+  cli/         `sub` CLI (reference: internal/cli)
+  tools/       container contract tools: nbwatch (reference: containertools)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Subcommand registration for `sub`. Placeholder registry; real commands
+(apply/get/delete/run/notebook/serve) land with the controller + client
+subsystems."""
+from __future__ import annotations
+
+
+def register(sub) -> None:
+    pass

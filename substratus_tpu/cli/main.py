@@ -1,0 +1,19 @@
+"""`sub` CLI entrypoint (reference: internal/cli/root.go:15-22).
+
+Commands are registered as the corresponding subsystems land; this module is
+the stable console-script target.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    from substratus_tpu.cli.root import run
+
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

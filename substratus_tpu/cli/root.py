@@ -1,0 +1,34 @@
+"""CLI command registry. Grows as subsystems land."""
+from __future__ import annotations
+
+import argparse
+from typing import List
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sub",
+        description="substratus-tpu: TPU-native ML on Kubernetes",
+    )
+    p.add_argument("--version", action="store_true", help="print version")
+    p.set_defaults(func=None)
+    sub = p.add_subparsers(dest="command")
+
+    from substratus_tpu.cli import commands
+
+    commands.register(sub)
+    return p
+
+
+def run(argv: List[str]) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if getattr(args, "version", False) and args.command is None:
+        from substratus_tpu import __version__
+
+        print(f"sub {__version__}")
+        return 0
+    if args.func is None:
+        parser.print_help()
+        return 1
+    return args.func(args)

@@ -1,0 +1,7 @@
+from substratus_tpu.load.hf import (
+    config_from_hf,
+    convert_llama_state_dict,
+    load_pretrained,
+)
+
+__all__ = ["config_from_hf", "convert_llama_state_dict", "load_pretrained"]

@@ -1,0 +1,149 @@
+"""HuggingFace checkpoint import -> substratus_tpu params.
+
+This is the in-repo replacement for the reference's external
+`substratusai/model-loader-huggingface` image (SURVEY.md §2.2;
+examples/llama2-7b/base-model.yaml:7): it turns HF Llama-family weights
+(safetensors) into the framework's stacked-layer pytree, ready to be sharded
+onto a mesh and/or written to `/content/artifacts` as an Orbax checkpoint
+(train/checkpoints.py).
+
+Weight-layout notes: HF Linear stores [out, in]; we store [in, ...out] so the
+forward pass is `x @ w` without transposes. RoPE uses the HF rotate-half
+convention (ops/basics.py), so no head permutation is needed.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from substratus_tpu.models.llama import CONFIGS, LlamaConfig, Params
+
+
+def config_from_hf(hf_cfg: Any) -> LlamaConfig:
+    """Map a transformers LlamaConfig(-like) object to LlamaConfig."""
+    get = lambda name, default=None: getattr(hf_cfg, name, default)
+    return LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=get("num_key_value_heads") or hf_cfg.num_attention_heads,
+        hidden_dim=hf_cfg.intermediate_size,
+        head_dim=get("head_dim"),
+        rope_theta=get("rope_theta", 10000.0),
+        norm_eps=get("rms_norm_eps", 1e-5),
+        max_seq_len=get("max_position_embeddings", 4096),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+
+
+def _np(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t)
+
+
+def convert_llama_state_dict(
+    sd: Mapping[str, Any], cfg: LlamaConfig, dtype=jnp.bfloat16
+) -> Params:
+    """HF Llama state dict -> stacked-layer params pytree."""
+    hd = cfg.head_size
+    L, D, H, KH, M = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.hidden_dim
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("", "model."):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    def stack(fmt: str, transform) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([transform(get(fmt.format(i=i))) for i in range(L)]), dtype
+        )
+
+    params: Params = {
+        "tok_embed": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "layers": {
+            "attn_norm": stack("layers.{i}.input_layernorm.weight", lambda w: w),
+            "wq": stack(
+                "layers.{i}.self_attn.q_proj.weight",
+                lambda w: w.T.reshape(D, H, hd),
+            ),
+            "wk": stack(
+                "layers.{i}.self_attn.k_proj.weight",
+                lambda w: w.T.reshape(D, KH, hd),
+            ),
+            "wv": stack(
+                "layers.{i}.self_attn.v_proj.weight",
+                lambda w: w.T.reshape(D, KH, hd),
+            ),
+            "wo": stack(
+                "layers.{i}.self_attn.o_proj.weight",
+                lambda w: w.T.reshape(H, hd, D),
+            ),
+            "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight", lambda w: w),
+            "w_gate": stack("layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
+            "w_up": stack("layers.{i}.mlp.up_proj.weight", lambda w: w.T),
+            "w_down": stack("layers.{i}.mlp.down_proj.weight", lambda w: w.T),
+        },
+        "out_norm": jnp.asarray(get("norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
+    return params
+
+
+def load_pretrained(
+    path_or_name: str, dtype=jnp.bfloat16
+) -> Tuple[LlamaConfig, Params]:
+    """Load an HF Llama-family checkpoint from a local dir (safetensors or
+    torch bin via transformers)."""
+    if os.path.isdir(path_or_name) and os.path.exists(
+        os.path.join(path_or_name, "config.json")
+    ):
+        with open(os.path.join(path_or_name, "config.json")) as f:
+            raw = json.load(f)
+        from types import SimpleNamespace
+
+        cfg = config_from_hf(SimpleNamespace(**raw))
+        sd: Dict[str, np.ndarray] = {}
+        st_files = [
+            f for f in os.listdir(path_or_name) if f.endswith(".safetensors")
+        ]
+        if st_files:
+            # framework="torch" rather than "numpy": numpy has no bfloat16,
+            # which is what Llama checkpoints ship in.
+            from safetensors import safe_open
+
+            for fname in sorted(st_files):
+                with safe_open(
+                    os.path.join(path_or_name, fname), framework="torch"
+                ) as f:
+                    for key in f.keys():
+                        sd[key] = f.get_tensor(key)
+        else:
+            import torch
+
+            for fname in sorted(os.listdir(path_or_name)):
+                if fname.endswith(".bin"):
+                    sd.update(
+                        torch.load(
+                            os.path.join(path_or_name, fname),
+                            map_location="cpu",
+                            weights_only=True,
+                        )
+                    )
+        return cfg, convert_llama_state_dict(sd, cfg, dtype)
+
+    # Fall back to transformers hub loading (requires network or cache).
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(path_or_name)
+    cfg = config_from_hf(hf_cfg)
+    model = AutoModelForCausalLM.from_pretrained(path_or_name)
+    params = convert_llama_state_dict(model.state_dict(), cfg, dtype)
+    return cfg, params

@@ -1,0 +1,4 @@
+from substratus_tpu.models import llama
+from substratus_tpu.models.llama import LlamaConfig
+
+__all__ = ["llama", "LlamaConfig"]

@@ -1,0 +1,280 @@
+"""Llama model family (Llama 2/3, TinyLlama, and shape-compatible configs).
+
+Flagship compute path of the framework. The reference operator ran Llama via
+external CUDA images (examples/llama2-7b/*.yaml -> substratusai/model-*
+images, SURVEY.md §2.2); here the model is in-repo, TPU-first:
+
+  * params are plain pytrees with per-layer weights STACKED on a leading
+    `layers` axis and the block applied via `lax.scan` — compile time is O(1)
+    in depth and XLA sees one fused block;
+  * every array carries a logical-axis annotation (parallel/sharding.py), so
+    dp/fsdp/tp/sp strategies are rules-table changes, not model edits;
+  * matmuls run in bfloat16 on the MXU with float32 softmax/norm accumulation;
+  * weights may be int8-quantized per-channel (ops/quant.py) — decode is
+    HBM-bandwidth-bound, so int8 weights nearly double decode throughput;
+  * RoPE follows the HF rotate-half convention so HF checkpoints convert
+    without permutation (load/hf.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from substratus_tpu.ops.attention import dot_product_attention
+from substratus_tpu.ops.basics import rms_norm, rope, swiglu
+from substratus_tpu.ops.quant import materialize
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    head_dim: Optional[int] = None
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_size(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.dim // self.n_heads
+
+    def replace(self, **kw) -> "LlamaConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Shape-accurate configs for the model sizes the reference's examples exercise
+# (examples/llama2-7b, llama2-13b-chat-gguf, llama2-70b) plus test sizes.
+CONFIGS: Dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=128, max_seq_len=128, norm_eps=1e-6,
+    ),
+    "debug-1b": LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        hidden_dim=5632, max_seq_len=2048,
+    ),
+    "llama2-7b": LlamaConfig(),
+    "llama2-13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40, hidden_dim=13824),
+    "llama2-70b": LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, hidden_dim=28672),
+    "llama3-8b": LlamaConfig(
+        vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        hidden_dim=14336, rope_theta=500000.0, max_seq_len=8192,
+    ),
+    "tinyllama-1.1b": LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=22, n_heads=32, n_kv_heads=4,
+        hidden_dim=5632, max_seq_len=2048,
+    ),
+}
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Logical axis names for every param leaf (see parallel/sharding.py)."""
+    axes = {
+        "tok_embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "out_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def quant_contracting(cfg: LlamaConfig) -> Params:
+    """Contracting dims per leaf for ops.quant.quantize_params; () = dense."""
+    q = {
+        "tok_embed": (),
+        "layers": {
+            "attn_norm": (),
+            "wq": (1,),
+            "wk": (1,),
+            "wv": (1,),
+            "wo": (1, 2),
+            "mlp_norm": (),
+            "w_gate": (1,),
+            "w_up": (1,),
+            "w_down": (1,),
+        },
+        "out_norm": (),
+    }
+    if not cfg.tie_embeddings:
+        q["lm_head"] = (0,)
+    return q
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Random init (truncated-normal fan-in scaling), stacked layers."""
+    hd = cfg.head_size
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+            * (fan_in**-0.5)
+        ).astype(cfg.dtype)
+
+    L, D, H, KH, M = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.hidden_dim
+    params: Params = {
+        "tok_embed": dense(next(k), (cfg.vocab_size, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "wq": dense(next(k), (L, D, H, hd), D),
+            "wk": dense(next(k), (L, D, KH, hd), D),
+            "wv": dense(next(k), (L, D, KH, hd), D),
+            "wo": dense(next(k), (L, H, hd, D), H * hd),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "w_gate": dense(next(k), (L, D, M), D),
+            "w_up": dense(next(k), (L, D, M), D),
+            "w_down": dense(next(k), (L, M, D), M),
+        },
+        "out_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (D, cfg.vocab_size), D)
+    return params
+
+
+def init_cache(
+    cfg: LlamaConfig, batch: int, max_len: Optional[int] = None, dtype=None
+) -> Params:
+    """Decode KV cache, layers-stacked: k/v [L, B, S, KH, head_dim]."""
+    S = max_len or cfg.max_seq_len
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: LlamaConfig) -> Params:
+    ax = ("layers", "cache_batch", "cache_seq", "kv_heads", "head_dim")
+    return {"k": ax, "v": ax}
+
+
+def _block(
+    x: jnp.ndarray,  # [B, S, D]
+    lp: Params,  # single-layer params (leading L axis removed by scan)
+    positions: jnp.ndarray,  # [B, S]
+    cfg: LlamaConfig,
+    layer_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+    kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """One transformer block. Returns (x_out, (k_entries, v_entries)) where
+    k/v entries are either the freshly computed seq entries (no cache: used
+    for training / prefill) or the updated full cache rows (decode)."""
+    dt = cfg.dtype
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, materialize(lp["wq"], dt))
+    kk = jnp.einsum("bsd,dhk->bshk", h, materialize(lp["wk"], dt))
+    vv = jnp.einsum("bsd,dhk->bshk", h, materialize(lp["wv"], dt))
+    q = rope(q, positions, cfg.rope_theta)
+    kk = rope(kk, positions, cfg.rope_theta)
+
+    if layer_cache is None:
+        attn = dot_product_attention(q, kk, vv, causal=True, q_positions=positions)
+        kv_out = (kk, vv)
+    else:
+        k_cache, v_cache = layer_cache  # [B, S, KH, hd]
+        b = x.shape[0]
+        rows = jnp.arange(b)[:, None]
+        k_cache = k_cache.at[rows, positions].set(kk.astype(k_cache.dtype))
+        v_cache = v_cache.at[rows, positions].set(vv.astype(v_cache.dtype))
+        attn = dot_product_attention(
+            q, k_cache, v_cache, causal=True, q_positions=positions,
+            kv_length=kv_length,
+        )
+        kv_out = (k_cache, v_cache)
+
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, materialize(lp["wo"], dt))
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,dm->bsm", h, materialize(lp["w_gate"], dt))
+    up = jnp.einsum("bsd,dm->bsm", h, materialize(lp["w_up"], dt))
+    x = x + jnp.einsum("bsm,md->bsd", swiglu(gate, up), materialize(lp["w_down"], dt))
+    return x, kv_out
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jnp.ndarray] = None,  # [B, S] absolute positions
+    cache: Optional[Params] = None,  # decode cache from init_cache
+    kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix; use
+    # when slots <= position may hold stale data (e.g. resumed caches)
+) -> Tuple[jnp.ndarray, Params]:
+    """Returns (logits [B, S, vocab], kv).
+
+    Without cache: training/prefill; kv = fresh entries [L, B, S, KH, hd]
+    (a cache fragment the serving engine can insert into a decode cache).
+    With cache: decode/continued generation; tokens are written at
+    `positions` (per-row) and attention runs over the full cache; kv = the
+    updated cache.
+    """
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = materialize(params["tok_embed"], cfg.dtype)[tokens]
+
+    def body(carry, layer_in):
+        if cache is None:
+            lp = layer_in
+            lcache = None
+        else:
+            lp, lcache = layer_in
+        x_out, kv = _block(carry, lp, positions, cfg, lcache, kv_length)
+        return x_out, kv
+
+    xs = (
+        params["layers"]
+        if cache is None
+        else (params["layers"], (cache["k"], cache["v"]))
+    )
+    x, (ks, vs) = lax.scan(body, x, xs)
+
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, materialize(params["tok_embed"], cfg.dtype)
+        )
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, materialize(params["lm_head"], cfg.dtype))
+    return logits.astype(jnp.float32), {"k": ks, "v": vs}
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def decode_step(
+    params: Params,
+    cache: Params,
+    tokens: jnp.ndarray,  # [B] current token per row
+    positions: jnp.ndarray,  # [B] position to write/attend at
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Params]:
+    """One greedy-decode-ready step: logits for the next token + updated
+    cache. Cache buffer is donated -> updated in place on device."""
+    logits, new_cache = forward(
+        params, tokens[:, None], cfg, positions=positions[:, None], cache=cache
+    )
+    return logits[:, 0, :], new_cache

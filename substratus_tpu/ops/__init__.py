@@ -1,0 +1,4 @@
+from substratus_tpu.ops.basics import rms_norm, rope, swiglu
+from substratus_tpu.ops.attention import dot_product_attention
+
+__all__ = ["rms_norm", "rope", "swiglu", "dot_product_attention"]

@@ -1,0 +1,62 @@
+"""Attention: XLA reference path with GQA + causal/decode masking.
+
+This is the always-correct fallback used on CPU tests and as the numerical
+oracle for the Pallas flash/ring kernels (ops/flash_attention.py,
+ops/ring_attention.py). Shapes follow the [batch, seq, heads, head_dim]
+convention throughout the framework.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import nn
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, KH, D]
+    v: jnp.ndarray,  # [B, Sk, KH, D]
+    *,
+    causal: bool = True,
+    q_positions: Optional[jnp.ndarray] = None,  # [B, Sq] absolute positions
+    kv_length: Optional[jnp.ndarray] = None,  # [B] valid kv prefix length
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Grouped-query attention with float32 softmax accumulation.
+
+    For decode-with-cache: pass the full cache as k/v, the query's absolute
+    positions as q_positions, and mask trailing garbage via causality
+    (cache slots > position are masked). kv_length additionally masks slots
+    beyond the filled prefix when positions alone aren't enough.
+    """
+    b, sq, h, d = q.shape
+    kh = k.shape[2]
+    assert h % kh == 0, f"query heads {h} not a multiple of kv heads {kh}"
+    group = h // kh
+    if scale is None:
+        scale = d**-0.5
+
+    qf = (q.astype(jnp.float32) * scale).reshape(b, sq, kh, group, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [B, KH, G, Sq, Sk]
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qf, kf)
+
+    sk = k.shape[1]
+    if causal:
+        if q_positions is None:
+            q_pos = jnp.arange(sq)[None, :].astype(jnp.int32)
+        else:
+            q_pos = q_positions.astype(jnp.int32)
+        k_pos = jnp.arange(sk, dtype=jnp.int32)
+        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # [B|1, Sq, Sk]
+        mask = mask[:, None, None, :, :]
+        logits = jnp.where(mask, logits, -1e30)
+    if kv_length is not None:
+        valid = jnp.arange(sk)[None, :] < kv_length[:, None]  # [B, Sk]
+        logits = jnp.where(valid[:, None, None, None, :], logits, -1e30)
+
+    probs = nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, vf)
+    return out.reshape(b, sq, h, d).astype(q.dtype)

@@ -1,0 +1,50 @@
+"""Elementwise / normalization / positional ops.
+
+These deliberately stay as plain jnp expressions: XLA fuses them into the
+surrounding matmuls, which is the right call on TPU (HBM-bandwidth-bound
+elementwise work should never round-trip). Pallas is reserved for ops XLA
+can't fuse well (attention, see ops/flash_attention.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm in float32 accumulation regardless of input dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_freqs(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2] (float32)."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def rope(
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float = 10000.0
+) -> jnp.ndarray:
+    """Rotary position embedding, HF-Llama "rotate_half" convention.
+
+    x: [..., seq, heads, head_dim]; positions: broadcastable to [..., seq].
+    The two rotated halves are x[..., :d/2] and x[..., d/2:] (NOT interleaved
+    pairs), matching transformers' LlamaRotaryEmbedding so HF checkpoints load
+    without permutation.
+    """
+    head_dim = x.shape[-1]
+    freqs = rope_freqs(head_dim, theta)  # [d/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., seq, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.silu(gate) * up

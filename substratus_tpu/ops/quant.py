@@ -1,0 +1,75 @@
+"""Weight-only int8 quantization.
+
+The reference delegates quantized serving to external images (4/8-bit via
+`MODEL_LOAD_IN_8BIT` env on basaran, llama.cpp GGUF — SURVEY.md §2.2). Here it
+is a first-class op: symmetric per-output-channel int8 with the scale kept in
+float32. Dequantization is expressed as `convert * scale` immediately feeding
+the matmul so XLA fuses it into the MXU operand read — HBM traffic halves
+(decode is bandwidth-bound) while accumulation stays bf16/f32.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QTensor:
+    """int8 values + broadcastable float32 scale (contracting dims size-1)."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return jnp.int8
+
+    def dequant(self, dtype=jnp.bfloat16) -> jnp.ndarray:
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize(w: jnp.ndarray, contracting: Sequence[int]) -> QTensor:
+    """Symmetric int8 quantization, per-channel over non-contracting dims."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=tuple(contracting), keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def materialize(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """QTensor -> dense; dense floating arrays are cast to `dtype` so the
+    matmul dtype policy (bf16 on the MXU) holds regardless of storage dtype."""
+    if isinstance(w, QTensor):
+        return w.dequant(dtype)
+    if jnp.issubdtype(w.dtype, jnp.floating) and w.dtype != dtype:
+        return w.astype(dtype)
+    return w
+
+
+def quantize_params(params: Any, contracting_of: Any) -> Any:
+    """Quantize every leaf with a non-empty entry in `contracting_of` (a
+    pytree matching `params` whose leaves are contracting-dim tuples; the
+    empty tuple means keep dense — norms and embeddings stay bf16).
+    """
+
+    def one(w, contracting):
+        if not contracting:
+            return w
+        return quantize(w, contracting)
+
+    return jax.tree.map(one, params, contracting_of)

@@ -1,0 +1,19 @@
+from substratus_tpu.parallel.mesh import MESH_AXES, build_mesh, local_mesh
+from substratus_tpu.parallel.sharding import (
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    shard_tree,
+    spec_for,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "build_mesh",
+    "local_mesh",
+    "LogicalRules",
+    "DEFAULT_RULES",
+    "logical_sharding",
+    "shard_tree",
+    "spec_for",
+]

@@ -1,0 +1,87 @@
+"""Device mesh construction over ICI and DCN.
+
+The reference framework has no multi-device compute at all (SURVEY.md §2.3:
+its only knob is `resources.gpu.count` on one pod, api/v1/common_types.go:102).
+Here the mesh is the foundation every parallel form hangs off:
+
+  axis        parallelism
+  ----        -----------
+  "data"      pure data parallelism (replicated params)
+  "fsdp"      ZeRO-3 style data parallelism (params sharded over this axis)
+  "sequence"  context/sequence parallelism (ring attention shards seq here)
+  "tensor"    megatron-style tensor parallelism (heads / mlp sharded)
+  "expert"    expert parallelism for MoE layers
+
+Multi-slice TPU pods: ICI connects chips within a slice, DCN connects slices.
+`build_mesh` accepts `dcn_data` (number of slices) and places it as the
+outermost axis so that only the data axis crosses DCN — all other collectives
+ride ICI, per the scaling-book recipe.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Order matters: outer-to-inner. data outermost so multi-slice DCN traffic is
+# confined to gradient/all-reduce on the data axis.
+MESH_AXES = ("data", "fsdp", "sequence", "tensor", "expert")
+
+
+def build_mesh(
+    data: int = 1,
+    fsdp: int = 1,
+    sequence: int = 1,
+    tensor: int = 1,
+    expert: int = 1,
+    *,
+    dcn_data: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named Mesh over the given (or all) devices.
+
+    Any axis may be -1 exactly once, meaning "all remaining devices".
+    With dcn_data > 1 the devices are assumed grouped by slice (jax.devices()
+    returns them in process/slice order) and `data` must be divisible by it;
+    jax.experimental.mesh_utils handles hybrid ICI/DCN placement when
+    available.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = [data, fsdp, sequence, tensor, expert]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh sizes {sizes} != device count {n}")
+
+    if dcn_data > 1:
+        from jax.experimental import mesh_utils
+
+        if sizes[0] % dcn_data:
+            raise ValueError(
+                f"data axis {sizes[0]} not divisible by dcn slices {dcn_data}"
+            )
+        ici = [sizes[0] // dcn_data] + sizes[1:]
+        dcn = [dcn_data] + [1] * (len(sizes) - 1)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices
+        )
+    else:
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def local_mesh() -> Mesh:
+    """Single-chip (or fully data-parallel) trivial mesh; used for bench and
+    single-host serving."""
+    n = len(jax.devices())
+    return build_mesh(data=n)

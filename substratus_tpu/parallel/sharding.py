@@ -1,0 +1,119 @@
+"""Logical-axis sharding rules (greenfield; see SURVEY.md §2.3).
+
+Arrays in models/ are annotated with *logical* axis names; a rules table maps
+each logical name to zero or more *mesh* axes. This is the standard TPU recipe
+(pick a mesh, annotate shardings, let XLA insert collectives) decoupled from
+any one model: changing the parallelism strategy means changing the rules
+table, not the model code.
+
+Logical axis vocabulary:
+  activations: "batch", "seq", "act_embed", "act_heads", "act_kv", "act_mlp"
+  params:      "vocab", "embed", "heads", "kv_heads", "head_dim", "mlp",
+               "layers" (scan axis, never sharded), "expert", "lora_rank"
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Axes = Tuple[Optional[Union[str, Tuple[str, ...]]], ...]
+
+
+@dataclass(frozen=True)
+class LogicalRules:
+    """Mapping from logical axis name -> mesh axis (or tuple of mesh axes)."""
+
+    rules: Tuple[Tuple[str, Union[None, str, Tuple[str, ...]]], ...]
+
+    def mesh_axes(self, logical: Sequence[Optional[str]]) -> P:
+        table = dict(self.rules)
+        out, used = [], set()
+        for name in logical:
+            if name is None:
+                out.append(None)
+                continue
+            mapped = table.get(name)
+            # A mesh axis may appear only once in a PartitionSpec; later
+            # logical axes that map to an already-used mesh axis stay
+            # replicated (matches flax.linen logical partitioning semantics).
+            if mapped is None:
+                out.append(None)
+                continue
+            axes = (mapped,) if isinstance(mapped, str) else tuple(mapped)
+            free = tuple(a for a in axes if a not in used)
+            used.update(free)
+            if not free:
+                out.append(None)
+            elif len(free) == 1:
+                out.append(free[0])
+            else:
+                out.append(free)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def replace(self, **kv) -> "LogicalRules":
+        table = dict(self.rules)
+        table.update(kv)
+        return LogicalRules(tuple(table.items()))
+
+
+# Training defaults: FSDP shards the param embed dim, tensor shards heads/mlp,
+# batch is data-parallel over both data and fsdp axes, sequence parallelism
+# shards activation seq.
+DEFAULT_RULES = LogicalRules(
+    (
+        ("batch", ("data", "fsdp")),
+        ("seq", "sequence"),
+        ("act_embed", None),
+        ("act_heads", "tensor"),
+        ("act_kv", "tensor"),
+        ("act_mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("embed", "fsdp"),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+        ("head_dim", None),
+        ("mlp", "tensor"),
+        ("layers", None),
+        ("expert", "expert"),
+        ("lora_rank", None),
+        ("cache_batch", ("data", "fsdp")),
+        ("cache_seq", None),
+    )
+)
+
+# Serving: no fsdp (weights fit, or are tensor-sharded); batch over data.
+SERVE_RULES = DEFAULT_RULES.replace(
+    batch="data", embed=None, cache_batch="data"
+)
+
+
+def spec_for(logical: Sequence[Optional[str]], rules: LogicalRules = DEFAULT_RULES) -> P:
+    return rules.mesh_axes(logical)
+
+
+def logical_sharding(
+    mesh: Mesh, logical_tree: Any, rules: LogicalRules = DEFAULT_RULES
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda ax: NamedSharding(mesh, rules.mesh_axes(ax)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_tree(
+    tree: Any,
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: LogicalRules = DEFAULT_RULES,
+) -> Any:
+    """Device-put a pytree according to its logical annotations."""
+    shardings = logical_sharding(mesh, logical_tree, rules)
+    return jax.tree.map(jax.device_put, tree, shardings)

@@ -1,0 +1,35 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX is used.
+
+Mirrors the reference's trick of testing the control plane without real
+infrastructure (reference: internal/controller/main_test.go uses envtest +
+faked Job/Pod status instead of a kubelet): here we test TPU sharding logic
+without TPUs by giving XLA 8 virtual host devices.
+
+The environment injects a TPU-tunnel PJRT plugin ("axon") via sitecustomize
+that intercepts backend init even under JAX_PLATFORMS=cpu; when the tunnel is
+wedged every jax.devices() call hangs. Tests must never depend on the tunnel,
+so the axon factory is removed outright before any backend initializes.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """A 2x2x2 (data, fsdp, tensor) mesh over 8 virtual CPU devices."""
+    from substratus_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(data=2, fsdp=2, tensor=2)

@@ -1,0 +1,106 @@
+"""Headline benchmark: Llama-2-7B decode throughput per chip (int8 weights).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (the reference publishes no perf numbers — BASELINE.md):
+the north star is >=2000 tok/s aggregate serving Llama-2-70B on a v5e-16
+slice, i.e. 125 tok/s/chip at 70B. Decode is HBM-bandwidth-bound, so the
+7B-equivalent per-chip parity target is 125 * (70/7) = 1250 tok/s/chip.
+vs_baseline = measured / 1250.
+
+Runs on the real chip (no JAX_PLATFORMS override). Weights are random but
+shape/dtype-exact (int8 + per-channel scales created directly on device), so
+the measured step time equals real-checkpoint serving decode step time.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from substratus_tpu.models import llama
+from substratus_tpu.ops.quant import QTensor
+
+BASELINE_TOK_S_PER_CHIP = 1250.0
+
+
+def random_quantized_params(cfg: llama.LlamaConfig, key: jax.Array):
+    """Random int8 params created quantized (no bf16 transient: a 7B bf16
+    tree would not coexist with its int8 copy in 16G HBM)."""
+    contracting = llama.quant_contracting(cfg)
+    shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k), key)
+
+    def one(shape_struct, contr, key):
+        shape = shape_struct.shape
+        if not contr:
+            return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(
+                cfg.dtype
+            )
+        scale_shape = tuple(
+            1 if i in contr else d for i, d in enumerate(shape)
+        )
+        q = jax.random.randint(key, shape, -127, 128, jnp.int8)
+        scale = jnp.full(scale_shape, 0.02 / 127.0, jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    leaves, treedef = jax.tree.flatten(shapes)
+    contr_leaves = treedef.flatten_up_to(contracting)
+    keys = jax.random.split(key, len(leaves))
+    out = [one(s, c, k) for s, c, k in zip(leaves, contr_leaves, keys)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def main(
+    batch: int = 8,
+    cache_len: int = 512,
+    steps: int = 64,
+    config: str = "llama2-7b",
+) -> None:
+    cfg = llama.CONFIGS[config]
+    params = jax.jit(
+        lambda k: random_quantized_params(cfg, k)
+    )(jax.random.key(0))
+    jax.block_until_ready(params)
+
+    cache = llama.init_cache(cfg, batch, cache_len)
+    tokens = jnp.ones((batch,), jnp.int32)
+    pos0 = 16  # pretend a short prefix was prefilled
+
+    # Warmup / compile.
+    positions = jnp.full((batch,), pos0, jnp.int32)
+    logits, cache = llama.decode_step(params, cache, tokens, positions, cfg)
+    jax.block_until_ready(logits)
+
+    # Timed steady-state decode.
+    t0 = time.perf_counter()
+    for i in range(steps):
+        positions = jnp.full((batch,), pos0 + 1 + i, jnp.int32)
+        logits, cache = llama.decode_step(params, cache, tokens, positions, cfg)
+    jax.block_until_ready(logits)
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"{config.replace('-', '_')}_int8_decode_throughput_per_chip",
+                "value": round(tok_s, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--config", default="llama2-7b")
+    a = ap.parse_args()
+    main(a.batch, a.cache_len, a.steps, a.config)

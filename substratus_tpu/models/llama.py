@@ -172,6 +172,14 @@ def cache_logical_axes(cfg: LlamaConfig) -> Params:
     return {"k": ax, "v": ax}
 
 
+def _lora_delta(
+    h: jnp.ndarray, adapter, scale, out_einsum: str
+) -> jnp.ndarray:
+    """h @ A @ B * scale (LoRA low-rank update; train/lora.py owns init)."""
+    down = jnp.einsum("bsd,dr->bsr", h, adapter["a"])
+    return jnp.einsum(out_einsum, down, adapter["b"]) * scale
+
+
 def _block(
     x: jnp.ndarray,  # [B, S, D]
     lp: Params,  # single-layer params (leading L axis removed by scan)
@@ -179,15 +187,25 @@ def _block(
     cfg: LlamaConfig,
     layer_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
+    lora_layers: Optional[Params] = None,  # single-layer adapter tree
+    lora_scale: float = 1.0,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One transformer block. Returns (x_out, (k_entries, v_entries)) where
     k/v entries are either the freshly computed seq entries (no cache: used
     for training / prefill) or the updated full cache rows (decode)."""
     dt = cfg.dtype
+    lora = lora_layers or {}
+
+    def proj(name: str, inp: jnp.ndarray, eq: str, lora_eq: str) -> jnp.ndarray:
+        out = jnp.einsum(eq, inp, materialize(lp[name], dt))
+        if name in lora:
+            out = out + _lora_delta(inp, lora[name], lora_scale, lora_eq)
+        return out
+
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, materialize(lp["wq"], dt))
-    kk = jnp.einsum("bsd,dhk->bshk", h, materialize(lp["wk"], dt))
-    vv = jnp.einsum("bsd,dhk->bshk", h, materialize(lp["wv"], dt))
+    q = proj("wq", h, "bsd,dhk->bshk", "bsr,rhk->bshk")
+    kk = proj("wk", h, "bsd,dhk->bshk", "bsr,rhk->bshk")
+    vv = proj("wv", h, "bsd,dhk->bshk", "bsr,rhk->bshk")
     q = rope(q, positions, cfg.rope_theta)
     kk = rope(kk, positions, cfg.rope_theta)
 
@@ -206,11 +224,16 @@ def _block(
         )
         kv_out = (k_cache, v_cache)
 
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, materialize(lp["wo"], dt))
+    b, s = x.shape[:2]
+    attn_flat = attn.reshape(b, s, -1)
+    o = jnp.einsum("bshk,hkd->bsd", attn, materialize(lp["wo"], dt))
+    if "wo" in lora:
+        o = o + _lora_delta(attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd")
+    x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,dm->bsm", h, materialize(lp["w_gate"], dt))
-    up = jnp.einsum("bsd,dm->bsm", h, materialize(lp["w_up"], dt))
-    x = x + jnp.einsum("bsm,md->bsd", swiglu(gate, up), materialize(lp["w_down"], dt))
+    gate = proj("w_gate", h, "bsd,dm->bsm", "bsr,rm->bsm")
+    up = proj("w_up", h, "bsd,dm->bsm", "bsr,rm->bsm")
+    x = x + proj("w_down", swiglu(gate, up), "bsm,md->bsd", "bsr,rd->bsd")
     return x, kv_out
 
 
@@ -223,6 +246,8 @@ def forward(
     cache: Optional[Params] = None,  # decode cache from init_cache
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix; use
     # when slots <= position may hold stale data (e.g. resumed caches)
+    lora: Optional[Params] = None,  # adapter tree from train.lora.init_lora
+    remat: bool = False,  # rematerialize each block (training memory saver)
 ) -> Tuple[jnp.ndarray, Params]:
     """Returns (logits [B, S, vocab], kv).
 
@@ -238,20 +263,28 @@ def forward(
 
     x = materialize(params["tok_embed"], cfg.dtype)[tokens]
 
+    lora_scale = lora["scale"] if lora is not None else 1.0
+
     def body(carry, layer_in):
-        if cache is None:
-            lp = layer_in
-            lcache = None
-        else:
-            lp, lcache = layer_in
-        x_out, kv = _block(carry, lp, positions, cfg, lcache, kv_length)
+        x_out, kv = _block(
+            carry,
+            layer_in["lp"],
+            positions,
+            cfg,
+            layer_in.get("cache"),
+            kv_length,
+            layer_in.get("lora"),
+            lora_scale,
+        )
         return x_out, kv
 
-    xs = (
-        params["layers"]
-        if cache is None
-        else (params["layers"], (cache["k"], cache["v"]))
-    )
+    xs: Dict[str, Any] = {"lp": params["layers"]}
+    if cache is not None:
+        xs["cache"] = (cache["k"], cache["v"])
+    if lora is not None:
+        xs["lora"] = lora["layers"]
+    if remat:
+        body = jax.checkpoint(body)
     x, (ks, vs) = lax.scan(body, x, xs)
 
     x = rms_norm(x, params["out_norm"], cfg.norm_eps)

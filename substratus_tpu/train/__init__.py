@@ -1,0 +1,7 @@
+from substratus_tpu.train.trainer import (
+    TrainConfig,
+    Trainer,
+    cross_entropy_loss,
+)
+
+__all__ = ["TrainConfig", "Trainer", "cross_entropy_loss"]

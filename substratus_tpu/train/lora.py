@@ -1,0 +1,85 @@
+"""LoRA adapters for the Llama family.
+
+The reference's finetuning ran inside `substratusai/model-trainer-huggingface`
+(SURVEY.md §2.2, examples/llama2-7b/finetuned-model.yaml) using HF PEFT-style
+params; here LoRA is native: adapter pytrees parallel the stacked-layer base
+params, the base stays frozen (and may be int8-quantized — QLoRA-style), and
+only the adapters receive gradients, so FSDP only needs to all-gather the tiny
+A/B matrices during the optimizer step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from substratus_tpu.models.llama import LlamaConfig
+
+LoraParams = Dict[str, Any]
+
+# Which projections get adapters (HF PEFT default for Llama is q,v).
+DEFAULT_TARGETS = ("wq", "wv")
+
+
+def init_lora(
+    cfg: LlamaConfig,
+    key: jax.Array,
+    rank: int = 8,
+    alpha: float = 16.0,
+    targets: Tuple[str, ...] = DEFAULT_TARGETS,
+    dtype=jnp.bfloat16,
+) -> LoraParams:
+    """A [L, in, r] is gaussian, B [L, r, ...out] is zero (standard LoRA init
+    so training starts from the base model)."""
+    hd = cfg.head_size
+    out_shape = {
+        "wq": (cfg.n_heads, hd),
+        "wk": (cfg.n_kv_heads, hd),
+        "wv": (cfg.n_kv_heads, hd),
+        "wo": (cfg.dim,),
+        "w_gate": (cfg.hidden_dim,),
+        "w_up": (cfg.hidden_dim,),
+        "w_down": (cfg.dim,),
+    }
+    in_dim = {
+        "wq": cfg.dim, "wk": cfg.dim, "wv": cfg.dim,
+        "wo": cfg.n_heads * hd,
+        "w_gate": cfg.dim, "w_up": cfg.dim,
+        "w_down": cfg.hidden_dim,
+    }
+    keys = jax.random.split(key, len(targets))
+    layers: Dict[str, Any] = {}
+    for k, name in zip(keys, targets):
+        a = (
+            jax.random.normal(k, (cfg.n_layers, in_dim[name], rank), jnp.float32)
+            * (1.0 / rank)
+        ).astype(dtype)
+        b = jnp.zeros((cfg.n_layers, rank) + out_shape[name], dtype)
+        layers[name] = {"a": a, "b": b}
+    # NOTE: the adapter-layer tree alone is returned; the (static) scale
+    # alpha/rank is NOT part of the pytree so it can never receive gradients
+    # or weight decay. Callers pass {"layers": adapters, "scale": alpha/rank}
+    # to models.llama.forward.
+    return layers
+
+
+def lora_logical_axes(adapters: LoraParams) -> LoraParams:
+    """Logical axes for the adapter-layer tree (rank never sharded)."""
+    out_axes = {
+        "wq": ("layers", "lora_rank", "heads", "head_dim"),
+        "wk": ("layers", "lora_rank", "kv_heads", "head_dim"),
+        "wv": ("layers", "lora_rank", "kv_heads", "head_dim"),
+        "wo": ("layers", "lora_rank", "embed"),
+        "w_gate": ("layers", "lora_rank", "mlp"),
+        "w_up": ("layers", "lora_rank", "mlp"),
+        "w_down": ("layers", "lora_rank", "embed"),
+    }
+    axes_layers = {}
+    for name in adapters:
+        in_axis = "mlp" if name == "w_down" else "embed"
+        axes_layers[name] = {
+            "a": ("layers", in_axis, "lora_rank"),
+            "b": out_axes[name],
+        }
+    return axes_layers

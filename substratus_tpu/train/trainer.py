@@ -1,0 +1,223 @@
+"""pjit trainer: FSDP/TP/SP-sharded training with optional LoRA.
+
+This is the in-repo replacement for the reference's external
+`substratusai/model-trainer-huggingface` image (SURVEY.md §2.2). Where that
+image ran single-pod HF Trainer on CUDA (max seen: 8xL4 on one node,
+examples/falcon-40b/finetuned-model.yaml), this trainer is written for SPMD
+over a TPU mesh from the start:
+
+  * one jitted train step with NamedSharding-annotated params/opt-state;
+    XLA inserts the all-gathers/reduce-scatters FSDP needs;
+  * optional LoRA mode: base params frozen (optionally int8), gradients and
+    optimizer state only for adapters;
+  * remat (jax.checkpoint) over each scanned block to trade FLOPs for HBM;
+  * loss masking via a per-token weight array (padding / prompt masking).
+
+Container contract: `python -m substratus_tpu.train.main` reads
+/content/params.json, data from /content/data, base model from
+/content/model, writes checkpoints to /content/artifacts (reference:
+docs/container-contract.md:5-56).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from substratus_tpu.models import llama
+from substratus_tpu.models.llama import LlamaConfig, Params
+from substratus_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    LogicalRules,
+    logical_sharding,
+)
+from substratus_tpu.train import lora as lora_lib
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 2e-5
+    weight_decay: float = 0.0
+    warmup_steps: int = 10
+    total_steps: int = 100
+    grad_clip: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.999
+    # LoRA: rank 0 disables (full finetune)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    remat: bool = True
+    seed: int = 0
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,  # [B, S, V] float32
+    targets: jnp.ndarray,  # [B, S] int32
+    weights: Optional[jnp.ndarray] = None,  # [B, S] 0/1 loss mask
+) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if weights is None:
+        return nll.mean()
+    weights = weights.astype(jnp.float32)
+    return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=tc.learning_rate,
+        warmup_steps=tc.warmup_steps,
+        decay_steps=max(tc.total_steps, tc.warmup_steps + 1),
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(
+            schedule, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay
+        ),
+    )
+
+
+class Trainer:
+    """Owns sharded params/opt-state and the jitted train step.
+
+    In LoRA mode `trainable` is the adapter tree and `params` stays frozen;
+    otherwise `trainable` IS the params tree.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        tc: TrainConfig,
+        mesh: Mesh,
+        params: Optional[Params] = None,
+        rules: LogicalRules = DEFAULT_RULES,
+    ):
+        self.cfg, self.tc, self.mesh, self.rules = cfg, tc, mesh, rules
+        self.optimizer = make_optimizer(tc)
+        key_params, key_lora = jax.random.split(jax.random.key(tc.seed))
+
+        param_sh = logical_sharding(mesh, llama.param_logical_axes(cfg), rules)
+        if params is None:
+            init = jax.jit(
+                partial(llama.init_params, cfg), out_shardings=param_sh
+            )
+            params = init(key_params)
+        else:
+            params = jax.tree.map(jax.device_put, params, param_sh)
+        self.params = params
+        self.param_shardings = param_sh
+
+        if tc.lora_rank > 0:
+            adapters = lora_lib.init_lora(
+                cfg, key_lora, rank=tc.lora_rank, alpha=tc.lora_alpha
+            )
+            self.lora_scale = tc.lora_alpha / tc.lora_rank
+            self.lora_shardings = logical_sharding(
+                mesh, lora_lib.lora_logical_axes(adapters), rules
+            )
+            self.lora = jax.tree.map(
+                jax.device_put, adapters, self.lora_shardings
+            )
+            trainable_sh = self.lora_shardings
+            trainable = self.lora
+        else:
+            self.lora = None
+            self.lora_scale = None
+            self.lora_shardings = None
+            trainable_sh = param_sh
+            trainable = params
+
+        self.opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=self._opt_shardings(trainable_sh),
+        )(trainable)
+        self.step = 0
+
+        batch_spec = rules.mesh_axes(("batch", "seq"))
+        self.batch_sharding = NamedSharding(mesh, batch_spec)
+        self._train_step = self._build_train_step()
+
+    def _opt_shardings(self, trainable_sh):
+        """Optimizer-state shardings: moment buffers mirror their param's
+        sharding (matched structurally via optax's param-tree mapping),
+        scalars (step counts) replicate."""
+        import optax.tree_utils as otu
+
+        trainable_shapes = self._trainable_shapes(trainable_sh)
+        opt_shapes = jax.eval_shape(self.optimizer.init, trainable_shapes)
+        replicated = NamedSharding(self.mesh, P())
+        return otu.tree_map_params(
+            self.optimizer,
+            lambda _, sh: sh,
+            opt_shapes,
+            trainable_sh,
+            transform_non_params=lambda _: replicated,
+        )
+
+    def _trainable_shapes(self, trainable_sh):
+        src = self.lora if self.lora is not None else self.params
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), src
+        )
+
+    def _build_train_step(self):
+        cfg, tc = self.cfg, self.tc
+        optimizer = self.optimizer
+        lora_mode = tc.lora_rank > 0
+
+        lora_scale = self.lora_scale if lora_mode else None
+
+        def loss_fn(trainable, frozen_params, batch):
+            if lora_mode:
+                params = frozen_params
+                lora = {"layers": trainable, "scale": lora_scale}
+            else:
+                params, lora = trainable, None
+            logits, _ = llama.forward(
+                params,
+                batch["tokens"],
+                cfg,
+                lora=lora,
+                remat=tc.remat,
+            )
+            return cross_entropy_loss(
+                logits[:, :-1], batch["tokens"][:, 1:], batch["weights"][:, 1:]
+            )
+
+        def train_step(trainable, frozen_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                trainable, frozen_params, batch
+            )
+            updates, opt_state = optimizer.update(
+                grads, opt_state, trainable
+            )
+            trainable = optax.apply_updates(trainable, updates)
+            return trainable, opt_state, loss
+
+        donate = (0, 2)  # trainable + opt_state buffers
+        return jax.jit(train_step, donate_argnums=donate)
+
+    def train_step(self, batch: Dict[str, jnp.ndarray]) -> float:
+        """batch: {"tokens": [B, S] int32, "weights": [B, S] 0/1}."""
+        batch = jax.tree.map(
+            lambda x: jax.device_put(x, self.batch_sharding), batch
+        )
+        trainable = self.lora if self.lora is not None else self.params
+        trainable, self.opt_state, loss = self._train_step(
+            trainable, self.params if self.lora is not None else None,
+            self.opt_state, batch,
+        )
+        if self.lora is not None:
+            self.lora = trainable
+        else:
+            self.params = trainable
+        self.step += 1
+        return float(loss)

@@ -1,0 +1,49 @@
+"""On-device token sampling (greedy / temperature / top-k / top-p).
+
+Runs inside the jitted decode step so logits never leave HBM; only the
+sampled token ids (a few bytes/row) cross to the host. Per-row temperature
+and top-p let a continuous-batching engine serve heterogeneous requests in
+one decode batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] float32; 0 => greedy for that row
+    top_k: int = 0,  # static; 0 disables
+    top_p: Optional[jnp.ndarray] = None,  # [B] float32 in (0, 1]; None disables
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Scale by temperature (guard 0 to avoid inf; greedy rows are overridden
+    # at the end anyway).
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / safe_t
+
+    if top_k and top_k < v:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    if top_p is not None:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always keep
+        # the first token).
+        keep_sorted = (cum - probs) < top_p[:, None]
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -114,6 +114,33 @@ def shard_tree(
     logical_tree: Any,
     rules: LogicalRules = DEFAULT_RULES,
 ) -> Any:
-    """Device-put a pytree according to its logical annotations."""
-    shardings = logical_sharding(mesh, logical_tree, rules)
-    return jax.tree.map(jax.device_put, tree, shardings)
+    """Device-put a pytree according to its logical annotations.
+
+    Handles int8 QTensor leaves (ops/quant.py): the quantized values take the
+    weight's sharding; the per-channel scale takes the same spec with size-1
+    (contracting, keepdims) dims left unsharded.
+    """
+    from substratus_tpu.ops.quant import QTensor
+
+    def one(leaf, axes):
+        spec = rules.mesh_axes(axes)
+        if isinstance(leaf, QTensor):
+            qspec = tuple(spec) + (None,) * (leaf.q.ndim - len(tuple(spec)))
+            sspec = P(
+                *[
+                    a if leaf.scale.shape[i] != 1 else None
+                    for i, a in enumerate(qspec)
+                ]
+            )
+            return QTensor(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, P(*qspec))),
+                scale=jax.device_put(leaf.scale, NamedSharding(mesh, sspec)),
+            )
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(
+        one,
+        tree,
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )

@@ -1,0 +1,3 @@
+from substratus_tpu.serve.engine import Engine, EngineConfig, Request
+
+__all__ = ["Engine", "EngineConfig", "Request"]

@@ -1,0 +1,279 @@
+"""Continuous-batching inference engine (prefill -> insert -> decode).
+
+The reference served models through external images (basaran / llama.cpp,
+SURVEY.md §2.2) with static batching; this engine is the in-repo TPU-native
+replacement, following the orchestrator pattern that works well on TPUs
+(fixed shapes, no dynamic batch):
+
+  * the decode batch is a fixed-size slot array; every jitted function sees
+    static shapes, so there is exactly one decode executable;
+  * prefill runs per-request at bucketed (power-of-two) lengths — a handful
+    of prefill executables — then the resulting KV fragment is INSERTed into
+    the decode cache at a free slot;
+  * decode advances every active slot one token per step, sampling on device
+    (ops/sampling.py); finished slots are freed and refilled between steps;
+  * weights may be int8 QTensors (ops/quant.py) for ~2x decode throughput.
+
+Threading model: callers enqueue Requests (thread-safe); one background
+scheduler thread owns all device state — no locks around jax values.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from substratus_tpu.models import llama
+from substratus_tpu.models.llama import LlamaConfig, Params
+from substratus_tpu.ops.sampling import sample
+
+
+@dataclass
+class EngineConfig:
+    max_batch: int = 8  # decode slots
+    max_seq_len: int = 1024  # cache length per slot
+    max_prefill_len: int = 512
+    top_k: int = 0  # static top-k (0 = disabled)
+    eos_token_id: int = 2
+
+
+@dataclass
+class Request:
+    prompt_tokens: List[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    # Each generated token id is put on this queue; None marks completion.
+    out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
+    id: str = ""
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class Engine:
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Params,
+        ec: EngineConfig = EngineConfig(),
+    ):
+        self.cfg, self.params, self.ec = cfg, params, ec
+        # A prefill fragment must fit in the cache; clamp so no request can
+        # ever produce an insert larger than a slot.
+        ec.max_prefill_len = min(ec.max_prefill_len, ec.max_seq_len)
+        B, S = ec.max_batch, ec.max_seq_len
+
+        self.cache = llama.init_cache(cfg, B, S)
+        self.tokens = jnp.zeros((B,), jnp.int32)
+        self.positions = jnp.zeros((B,), jnp.int32)
+        self.temps = jnp.zeros((B,), jnp.float32)
+        self.top_ps = jnp.ones((B,), jnp.float32)
+        self.key = jax.random.key(0)
+
+        # Host-side slot bookkeeping (scheduler thread only). host_positions
+        # mirrors the device positions array so per-token checks never force
+        # a device->host scalar read.
+        self.slot_req: List[Optional[Request]] = [None] * B
+        self.slot_generated: List[int] = [0] * B
+        self.active = np.zeros(B, dtype=bool)
+        self.host_positions = np.zeros(B, dtype=np.int64)
+
+        self.queue: "queue.Queue[Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+        self._admitting: Optional[Request] = None
+
+        self._decode_fn = self._build_decode()
+        self._prefill_fn = partial(self._prefill_jit, self.cfg)
+        self._insert_fn = self._build_insert()
+
+    # --- jitted device functions -----------------------------------------
+
+    @staticmethod
+    @partial(jax.jit, static_argnums=(0,))
+    def _prefill_jit(cfg, params, tokens, true_len):
+        """tokens [1, Sbucket] (right-padded); returns kv fragment + last
+        real token's logits."""
+        s = tokens.shape[1]
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+        logits, kv = llama.forward(params, tokens, cfg, positions=positions)
+        last = logits[0, true_len - 1]
+        return last, kv
+
+    def _build_insert(self):
+        @partial(jax.jit, donate_argnums=(0,))
+        def insert(cache, kv, slot):
+            # kv: [L, 1, Sb, KH, hd] fragment -> write into cache[:, slot, :Sb]
+            sb = kv["k"].shape[2]
+            k = jax.lax.dynamic_update_slice(
+                cache["k"], kv["k"].astype(cache["k"].dtype),
+                (0, slot, 0, 0, 0),
+            )
+            v = jax.lax.dynamic_update_slice(
+                cache["v"], kv["v"].astype(cache["v"].dtype),
+                (0, slot, 0, 0, 0),
+            )
+            return {"k": k, "v": v}
+
+        return insert
+
+    def _build_decode(self):
+        cfg, ec = self.cfg, self.ec
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode(params, cache, tokens, positions, temps, top_ps, key):
+            logits, cache = llama.forward(
+                params,
+                tokens[:, None],
+                cfg,
+                positions=positions[:, None],
+                cache=cache,
+            )
+            key, subkey = jax.random.split(key)
+            next_tokens = sample(
+                logits[:, 0], subkey, temps, top_k=ec.top_k, top_p=top_ps
+            )
+            return next_tokens, cache, key
+
+        return decode
+
+    # --- scheduler --------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        if self.error is not None:
+            req.out.put(None)  # engine is dead; never strand the caller
+            return req
+        self.queue.put(req)
+        return req
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+
+    def _admit(self):
+        """Fill free slots from the request queue (prefill + insert)."""
+        while not self.queue.empty() and not self.active.all():
+            try:
+                req = self.queue.get_nowait()
+            except queue.Empty:
+                return
+            self._admitting = req
+            slot = int(np.flatnonzero(~self.active)[0])
+            # Keep the newest max_prefill_len tokens, and leave at least one
+            # free cache slot for generation.
+            keep = min(self.ec.max_prefill_len, self.ec.max_seq_len - 1)
+            prompt = req.prompt_tokens[-keep:]
+            true_len = len(prompt)
+            bucket = min(_bucket(true_len), self.ec.max_prefill_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :true_len] = prompt
+            last_logits, kv = self._prefill_fn(
+                self.params, jnp.asarray(padded), true_len
+            )
+            self.cache = self._insert_fn(self.cache, kv, slot)
+            # Sample the first generated token from the prefill logits.
+            self.key, subkey = jax.random.split(self.key)
+            first = sample(
+                last_logits[None, :],
+                subkey,
+                jnp.array([req.temperature], jnp.float32),
+                top_k=self.ec.top_k,
+                top_p=jnp.array([req.top_p], jnp.float32),
+            )
+            first_id = int(first[0])
+
+            self.slot_req[slot] = req
+            self.slot_generated[slot] = 0
+            self.active[slot] = True
+            self.host_positions[slot] = true_len
+            self.tokens = self.tokens.at[slot].set(first_id)
+            self.positions = self.positions.at[slot].set(true_len)
+            self.temps = self.temps.at[slot].set(req.temperature)
+            self.top_ps = self.top_ps.at[slot].set(req.top_p)
+            self._admitting = None
+            self._emit(slot, first_id)
+
+    def _emit(self, slot: int, token_id: int):
+        req = self.slot_req[slot]
+        eos = req.eos_token_id if req.eos_token_id is not None else self.ec.eos_token_id
+        self.slot_generated[slot] += 1
+        done = (
+            token_id == eos
+            or self.slot_generated[slot] >= req.max_tokens
+            or int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
+        )
+        if token_id != eos:
+            req.out.put(token_id)
+        if done:
+            req.out.put(None)
+            self.active[slot] = False
+            self.slot_req[slot] = None
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                self._admit()
+                if not self.active.any():
+                    time.sleep(0.002)
+                    continue
+                next_tokens, self.cache, self.key = self._decode_fn(
+                    self.params,
+                    self.cache,
+                    self.tokens,
+                    self.positions,
+                    self.temps,
+                    self.top_ps,
+                    self.key,
+                )
+                self.positions = self.positions + 1
+                self.host_positions += 1
+                self.tokens = next_tokens
+                host_tokens = np.asarray(next_tokens)
+                for slot in np.flatnonzero(self.active):
+                    self._emit(int(slot), int(host_tokens[slot]))
+        except BaseException as e:  # propagate to waiting callers
+            self.error = e
+            if self._admitting is not None:
+                self._admitting.out.put(None)
+            for req in self.slot_req:
+                if req is not None:
+                    req.out.put(None)
+            while not self.queue.empty():
+                try:
+                    self.queue.get_nowait().out.put(None)
+                except queue.Empty:
+                    break
+            raise
+
+    # --- synchronous helper (tests / bench) -------------------------------
+
+    def generate(
+        self, prompt_tokens: List[int], max_tokens: int = 32, **kw
+    ) -> List[int]:
+        """Blocking single-request generation (engine must be started)."""
+        req = self.submit(Request(prompt_tokens, max_tokens=max_tokens, **kw))
+        out: List[int] = []
+        while True:
+            tok = req.out.get(timeout=120)
+            if tok is None:
+                return out
+            out.append(tok)

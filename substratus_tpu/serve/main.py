@@ -1,0 +1,100 @@
+"""Serving container entrypoint (container contract).
+
+The reference's Server CR pointed at external images like
+`substratusai/model-server-basaran` (examples/llama2-7b/server.yaml) obeying
+the contract: model weights RO-mounted at /content/model, params at
+/content/params.json, HTTP on :8080 with `GET /` readiness
+(docs/container-contract.md:38-56). This module is the in-repo TPU-native
+equivalent:
+
+    python -m substratus_tpu.serve.main [--model /content/model] [--port 8080]
+
+Params (from /content/params.json or flags): quantize=int8|none,
+max_batch, max_seq_len, config (named config for weightless smoke runs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict
+
+import jax
+
+
+def load_params_json(path: str = "/content/params.json") -> Dict[str, Any]:
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None, help="checkpoint dir (HF or orbax)")
+    ap.add_argument("--config", default=None, help="named config for random-weight smoke")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--quantize", default=None, choices=["int8", "none"])
+    args = ap.parse_args(argv)
+
+    params_json = load_params_json()
+    model_dir = args.model or params_json.get("model") or (
+        "/content/model" if os.path.isdir("/content/model") else None
+    )
+    quantize = args.quantize or params_json.get("quantize", "none")
+    max_batch = args.max_batch or int(params_json.get("max_batch", 8))
+    max_seq_len = args.max_seq_len or int(params_json.get("max_seq_len", 1024))
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+    from substratus_tpu.serve.server import ServerState, serve_forever
+    from substratus_tpu.serve.tokenizer import load_tokenizer
+
+    if model_dir:
+        from substratus_tpu.train.checkpoints import maybe_restore_orbax
+
+        restored = maybe_restore_orbax(model_dir)
+        if restored is not None:
+            cfg, params = restored
+        else:
+            from substratus_tpu.load.hf import load_pretrained
+
+            cfg, params = load_pretrained(model_dir)
+        model_name = os.path.basename(os.path.normpath(model_dir))
+        tokenizer = load_tokenizer(model_dir)
+    else:
+        # Weightless smoke mode (reference parallel: the opt-125m CPU smoke
+        # in test/system.sh) — random init of a named config.
+        name = args.config or params_json.get("config", "tiny")
+        cfg = llama.CONFIGS[name]
+        tokenizer = load_tokenizer(None)
+        if cfg.vocab_size < tokenizer.vocab_size:
+            cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
+        params = llama.init_params(cfg, jax.random.key(0))
+        model_name = name
+
+    if quantize == "int8":
+        from substratus_tpu.ops.quant import quantize_params
+
+        params = jax.jit(
+            lambda p: quantize_params(p, llama.quant_contracting(cfg))
+        )(params)
+
+    ec = EngineConfig(
+        max_batch=max_batch,
+        max_seq_len=min(max_seq_len, cfg.max_seq_len),
+        eos_token_id=tokenizer.eos_id if tokenizer.eos_id is not None else 2,
+    )
+    engine = Engine(cfg, params, ec)
+    engine.start()
+    state = ServerState(engine, tokenizer, model_name)
+    print(f"serving {model_name} on {args.host}:{args.port}", flush=True)
+    serve_forever(state, host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

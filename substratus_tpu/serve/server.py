@@ -1,0 +1,228 @@
+"""OpenAI-compatible HTTP server on the container contract.
+
+Contract (reference: docs/container-contract.md:50-56, test/system.sh:73-78):
+  * listens on port 8080;
+  * `GET /` returns 200 once the model is ready (readiness probe target);
+  * `POST /v1/completions` accepts {prompt, max_tokens, temperature, top_p,
+    stream} and returns an OpenAI-style completion body.
+
+Also exposes `/v1/chat/completions` (template-joined messages) and
+`/v1/models`. The HTTP layer is a thin asyncio shim over the Engine's
+thread-safe request queue; all device work stays on the engine thread.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from substratus_tpu.serve.engine import Engine, Request
+from substratus_tpu.serve.tokenizer import Tokenizer
+
+
+class ServerState:
+    def __init__(self, engine: Engine, tokenizer: Tokenizer, model_name: str):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.ready = True
+
+
+async def _collect(req: Request) -> list[int]:
+    """Await all tokens of a request without blocking the event loop."""
+    loop = asyncio.get_running_loop()
+    out: list[int] = []
+    while True:
+        tok = await loop.run_in_executor(None, req.out.get)
+        if tok is None:
+            return out
+        out.append(tok)
+
+
+def _completion_body(state: ServerState, text: str, n_prompt: int, n_gen: int):
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": state.model_name,
+        "choices": [
+            {"index": 0, "text": text, "finish_reason": "stop", "logprobs": None}
+        ],
+        "usage": {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": n_gen,
+            "total_tokens": n_prompt + n_gen,
+        },
+    }
+
+
+def build_app(state: ServerState) -> web.Application:
+    routes = web.RouteTableDef()
+
+    @routes.get("/")
+    async def root(request: web.Request) -> web.Response:
+        if state.engine.error is not None:
+            return web.Response(status=500, text=str(state.engine.error))
+        return web.Response(status=200 if state.ready else 503, text="ok")
+
+    @routes.get("/v1/models")
+    async def models(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": state.model_name,
+                        "object": "model",
+                        "owned_by": "substratus-tpu",
+                    }
+                ],
+            }
+        )
+
+    def _submit(prompt: str, body: dict) -> Request:
+        tok = state.tokenizer
+        req = Request(
+            prompt_tokens=tok.encode(prompt),
+            max_tokens=int(body.get("max_tokens", 16)),
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            eos_token_id=tok.eos_id,
+            id=uuid.uuid4().hex,
+        )
+        return state.engine.submit(req)
+
+    async def _generate(request: web.Request, prompt: str, body: dict):
+        req = _submit(prompt, body)
+        gen_ids = await _collect(req)
+        if state.engine.error is not None:
+            raise web.HTTPInternalServerError(text=str(state.engine.error))
+        return (
+            state.tokenizer.decode(gen_ids),
+            len(req.prompt_tokens),
+            len(gen_ids),
+        )
+
+    async def _stream(
+        request: web.Request, prompt: str, body: dict, chat: bool
+    ) -> web.StreamResponse:
+        """OpenAI-style SSE streaming: one data: chunk per decoded token,
+        then [DONE]. The engine already streams per-token through the
+        request queue; this just relays it."""
+        req = _submit(prompt, body)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        created = int(time.time())
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        pending: list[int] = []
+        while True:
+            tok_id = await loop.run_in_executor(None, req.out.get)
+            if tok_id is None:
+                if pending:  # flush any held-back trailing bytes
+                    piece = state.tokenizer.decode(pending)
+                    yield_final = True
+                else:
+                    break
+            else:
+                pending.append(tok_id)
+                piece = state.tokenizer.decode(pending)
+                # Hold back a partial UTF-8 codepoint, but never more than 4
+                # tokens (genuinely invalid bytes must still stream).
+                if "�" in piece and len(pending) < 4:
+                    continue
+                yield_final = False
+            pending = []
+            if chat:
+                choice = {"index": 0, "delta": {"content": piece}, "finish_reason": None}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": piece, "finish_reason": None}
+                obj = "text_completion"
+            chunk = {
+                "id": cid,
+                "object": obj,
+                "created": created,
+                "model": state.model_name,
+                "choices": [choice],
+            }
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            if yield_final:
+                break
+        done_choice = (
+            {"index": 0, "delta": {}, "finish_reason": "stop"}
+            if chat
+            else {"index": 0, "text": "", "finish_reason": "stop"}
+        )
+        final = {
+            "id": cid,
+            "object": "chat.completion.chunk" if chat else "text_completion",
+            "created": created,
+            "model": state.model_name,
+            "choices": [done_choice],
+        }
+        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    @routes.post("/v1/completions")
+    async def completions(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise web.HTTPBadRequest(text="missing 'prompt'")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        if body.get("stream"):
+            return await _stream(request, str(prompt), body, chat=False)
+        text, n_prompt, n_gen = await _generate(request, str(prompt), body)
+        return web.json_response(_completion_body(state, text, n_prompt, n_gen))
+
+    @routes.post("/v1/chat/completions")
+    async def chat(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        messages = body.get("messages") or []
+        prompt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
+        )
+        prompt += "\nassistant:"
+        if body.get("stream"):
+            return await _stream(request, prompt, body, chat=True)
+        text, n_prompt, n_gen = await _generate(request, prompt, body)
+        resp = _completion_body(state, text, n_prompt, n_gen)
+        resp["object"] = "chat.completion"
+        resp["choices"] = [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": "stop",
+            }
+        ]
+        return web.json_response(resp)
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
+def serve_forever(
+    state: ServerState, host: str = "0.0.0.0", port: int = 8080
+) -> None:
+    app = build_app(state)
+    web.run_app(app, host=host, port=port, print=None)

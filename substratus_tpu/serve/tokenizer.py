@@ -1,0 +1,60 @@
+"""Tokenizer abstraction for the serving stack.
+
+Real checkpoints use their HF tokenizer (tokenizer.json next to the weights);
+the ByteTokenizer serves tests and random-weight smoke configs (vocab 256+)
+without any tokenizer artifacts — mirroring how the reference's smoke test
+used the CPU-sized facebook/opt-125m (test/system.sh) rather than a real LLM.
+"""
+from __future__ import annotations
+
+from typing import List, Protocol
+
+
+class Tokenizer(Protocol):
+    eos_id: int
+
+    def encode(self, text: str) -> List[int]: ...
+    def decode(self, ids: List[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as tokens; ids 0..255 are bytes, 256 is BOS, 257 is EOS."""
+
+    bos_id = 256
+    eos_id = 257
+    vocab_size = 258
+
+    def encode(self, text: str) -> List[int]:
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wraps a transformers tokenizer loaded from a checkpoint directory."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.eos_id = self._tok.eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(path: str | None) -> Tokenizer:
+    if path is None:
+        return ByteTokenizer()
+    import os
+
+    if os.path.isdir(path) and any(
+        os.path.exists(os.path.join(path, f))
+        for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
+    ):
+        return HFTokenizer(path)
+    return ByteTokenizer()

@@ -1,0 +1,146 @@
+"""Orbax checkpointing: artifact format + preemption-safe training resume.
+
+The reference treats checkpointing as a storage convention — artifacts at an
+md5-addressed bucket path, `status.ready` short-circuits re-work, and
+`save_steps` params are delegated to the external trainer image (SURVEY.md §5
+"Checkpoint/resume"; cloud/common.go:45-66). Here it is a real subsystem:
+
+  * artifact layout: `<dir>/substratus.json` (model config + metadata) next
+    to an Orbax checkpoint tree — this is what `/content/artifacts` holds
+    after a Model run and what a Server mounts at `/content/model`;
+  * training: `CheckpointManager` saves (params | adapters) + opt state +
+    step asynchronously every `save_steps`, keeps the newest checkpoints,
+    and `restore_latest` resumes after preemption (TPU spot/maintenance
+    events make this mandatory).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from substratus_tpu.models.llama import CONFIGS, LlamaConfig, Params
+
+META_FILE = "substratus.json"
+
+
+def _cfg_to_dict(cfg: LlamaConfig) -> Dict[str, Any]:
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = np.dtype(cfg.dtype).name if cfg.dtype is not None else "bfloat16"
+    return d
+
+
+def _cfg_from_dict(d: Dict[str, Any]) -> LlamaConfig:
+    import jax.numpy as jnp
+
+    d = dict(d)
+    d["dtype"] = jnp.dtype(d.get("dtype", "bfloat16"))
+    return LlamaConfig(**d)
+
+
+def save_artifact(
+    path: str,
+    params: Params,
+    cfg: LlamaConfig,
+    extra_meta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Write a servable model artifact: orbax params + config sidecar."""
+    import orbax.checkpoint as ocp
+
+    os.makedirs(path, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        # force=True: artifact writes are idempotent, matching the
+        # reference's re-apply-into-existing-bucket semantics
+        # (docs/design.md:139-160).
+        ckptr.save(
+            os.path.join(os.path.abspath(path), "params"), params, force=True
+        )
+    meta = {"model_config": _cfg_to_dict(cfg), "format": "substratus-tpu-v1"}
+    meta.update(extra_meta or {})
+    with open(os.path.join(path, META_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def maybe_restore_orbax(
+    path: str, mesh=None, rules=None
+) -> Optional[Tuple[LlamaConfig, Params]]:
+    """Restore a save_artifact() dir; None if `path` isn't one (e.g. an HF
+    checkpoint dir, which load/hf.py handles).
+
+    Without a mesh the params land on the default device (single-chip
+    serving); with a mesh they restore directly into the logical-axis
+    shardings — artifacts written from an N-device training run restore onto
+    any topology.
+    """
+    meta_path = os.path.join(path, META_FILE)
+    if not os.path.exists(meta_path):
+        return None
+    import orbax.checkpoint as ocp
+    from substratus_tpu.models import llama
+    from substratus_tpu.parallel.sharding import DEFAULT_RULES, logical_sharding
+
+    with open(meta_path) as f:
+        meta = json.load(f)
+    cfg = _cfg_from_dict(meta["model_config"])
+    shapes = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.key(0)))
+    if mesh is not None:
+        shardings = logical_sharding(
+            mesh, llama.param_logical_axes(cfg), rules or DEFAULT_RULES
+        )
+    else:
+        one = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        shardings = jax.tree.map(lambda _: one, shapes)
+    shapes = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        shardings,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(
+            os.path.join(os.path.abspath(path), "params"), shapes
+        )
+    return cfg, params
+
+
+class CheckpointManager:
+    """Async training checkpoints with resume-latest semantics."""
+
+    def __init__(self, directory: str, save_steps: int = 100, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self.save_steps = max(1, save_steps)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def maybe_save(self, step: int, state: Dict[str, Any], force: bool = False):
+        if force or step % self.save_steps == 0:
+            import orbax.checkpoint as ocp
+
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore_latest(
+        self, abstract_state: Dict[str, Any]
+    ) -> Optional[Tuple[int, Dict[str, Any]]]:
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+        return step, state
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,103 @@
+"""Training data pipeline: files -> packed fixed-shape token batches.
+
+The reference's Dataset CR produced arbitrary files under /content/data via
+external loader images (SURVEY.md §2.2, examples/datasets/*.yaml); the
+trainer image consumed them opaquely. Here the consumption side is concrete
+and TPU-shaped: documents are tokenized, joined with EOS, and packed into
+dense [batch, seq_len] blocks — static shapes, no padding waste, so every
+step feeds the MXU identically.
+
+Supported inputs (a directory or a single file):
+  *.jsonl  — {"text": ...} or {"prompt": ..., "completion": ...} per line
+  *.txt    — plain text, one document per file
+  *.npy    — pre-tokenized 1-D int array (concatenated token stream)
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+def _iter_documents(path: str) -> Iterator[str]:
+    paths: List[str] = []
+    if os.path.isdir(path):
+        for root, _, files in os.walk(path):
+            paths.extend(os.path.join(root, f) for f in sorted(files))
+    else:
+        paths = [path]
+    for p in paths:
+        if p.endswith(".jsonl"):
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    if "text" in row:
+                        yield row["text"]
+                    elif "prompt" in row:
+                        yield str(row["prompt"]) + str(row.get("completion", ""))
+        elif p.endswith(".txt"):
+            with open(p) as f:
+                yield f.read()
+
+
+def _token_stream(path: str, tokenizer, eos_id: int) -> np.ndarray:
+    """Tokenize every document once into one contiguous stream."""
+    npys = []
+    if os.path.isdir(path):
+        for root, _, files in os.walk(path):
+            npys.extend(
+                os.path.join(root, f) for f in sorted(files) if f.endswith(".npy")
+            )
+    elif path.endswith(".npy"):
+        npys = [path]
+    chunks: List[np.ndarray] = []
+    for p in npys:
+        chunks.append(np.load(p).astype(np.int32).reshape(-1))
+    for doc in _iter_documents(path):
+        ids = tokenizer.encode(doc)
+        chunks.append(np.asarray(ids + [eos_id], np.int32))
+    if not chunks:
+        raise FileNotFoundError(f"no training documents found under {path}")
+    return np.concatenate(chunks)
+
+
+class PackedDataset:
+    """Infinite iterator of {"tokens": [B, S], "weights": [B, S]} batches."""
+
+    def __init__(
+        self,
+        path: str,
+        tokenizer,
+        batch_size: int,
+        seq_len: int,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        eos = eos_id if eos_id is not None else getattr(tokenizer, "eos_id", 0)
+        stream = _token_stream(path, tokenizer, eos)
+        n_blocks = len(stream) // seq_len
+        if n_blocks == 0:
+            # Tile tiny corpora up to one full block so smoke datasets work.
+            reps = seq_len // max(1, len(stream)) + 1
+            stream = np.tile(stream, reps)
+            n_blocks = len(stream) // seq_len
+        self.blocks = stream[: n_blocks * seq_len].reshape(n_blocks, seq_len)
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+        self.n_tokens = int(self.blocks.size)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        idx = self.rng.integers(0, len(self.blocks), size=self.batch_size)
+        tokens = self.blocks[idx]
+        return {
+            "tokens": tokens.astype(np.int32),
+            "weights": np.ones_like(tokens, np.float32),
+        }

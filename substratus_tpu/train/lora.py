@@ -64,6 +64,39 @@ def init_lora(
     return layers
 
 
+def merge_lora(
+    params: Any, adapters: LoraParams, scale: float
+) -> Any:
+    """Fold trained adapters into the base weights: W += scale * A @ B.
+
+    Returns a dense params tree (quantized bases are dequantized first) ready
+    for save_artifact/serving without adapter plumbing.
+    """
+    import jax.numpy as jnp
+
+    from substratus_tpu.ops.quant import materialize
+
+    from substratus_tpu.ops.quant import QTensor
+
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name, ab in adapters.items():
+        orig = layers[name]
+        out_dtype = jnp.bfloat16 if isinstance(orig, QTensor) else orig.dtype
+        w = materialize(orig, jnp.float32)
+        delta = jnp.einsum(
+            "ldr,lr...->ld...",
+            ab["a"].astype(jnp.float32),
+            ab["b"].astype(jnp.float32),
+        ) * scale
+        if name == "wo":
+            # adapter input is flattened [H*hd]; reshape delta to match W
+            delta = delta.reshape(w.shape)
+        layers[name] = (w + delta).astype(out_dtype)
+    out["layers"] = layers
+    return out
+
+
 def lora_logical_axes(adapters: LoraParams) -> LoraParams:
     """Logical axes for the adapter-layer tree (rank never sharded)."""
     out_axes = {

@@ -1,0 +1,172 @@
+"""Training container entrypoint (container contract).
+
+In-repo TPU-native replacement for `substratusai/model-trainer-huggingface`
+(SURVEY.md §2.2; examples/llama2-7b/finetuned-model.yaml). Contract
+(docs/container-contract.md:5-36): base model RO at /content/model, dataset
+RO at /content/data, hyperparameters at /content/params.json, outputs to
+/content/artifacts.
+
+    python -m substratus_tpu.train.main [--data DIR] [--model DIR] [--out DIR]
+
+params.json keys (HF-trainer-style names kept where the reference examples
+used them): steps, batch_size, seq_len, learning_rate, save_steps,
+lora_rank, lora_alpha, quantize (int8 => QLoRA), config (named model config
+when training from scratch), dp/fsdp/tensor/sequence (mesh axis sizes,
+default: all devices on fsdp).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default="/content/data")
+    ap.add_argument("--model", default=None, help="base model dir (optional)")
+    ap.add_argument("--out", default="/content/artifacts")
+    ap.add_argument("--params", default="/content/params.json")
+    args = ap.parse_args(argv)
+
+    p = {}
+    if os.path.exists(args.params):
+        with open(args.params) as f:
+            p = json.load(f)
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.parallel.mesh import build_mesh
+    from substratus_tpu.serve.tokenizer import load_tokenizer
+    from substratus_tpu.train.checkpoints import (
+        CheckpointManager,
+        maybe_restore_orbax,
+        save_artifact,
+    )
+    from substratus_tpu.train.data import PackedDataset
+    from substratus_tpu.train.lora import merge_lora
+    from substratus_tpu.train.trainer import TrainConfig, Trainer
+
+    steps = int(p.get("steps", p.get("max_steps", 100)))
+    batch_size = int(p.get("batch_size", 8))
+    seq_len = int(p.get("seq_len", 512))
+    lora_rank = int(p.get("lora_rank", 0))
+
+    model_dir = args.model or (
+        "/content/model" if os.path.isdir("/content/model") else None
+    )
+    params = None
+    if model_dir:
+        restored = maybe_restore_orbax(model_dir)
+        if restored is not None:
+            cfg, params = restored
+        else:
+            from substratus_tpu.load.hf import load_pretrained
+
+            cfg, params = load_pretrained(model_dir)
+        tokenizer = load_tokenizer(model_dir)
+    else:
+        cfg = llama.CONFIGS[p.get("config", "tiny")]
+        tokenizer = load_tokenizer(None)
+        if cfg.vocab_size < tokenizer.vocab_size:
+            cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
+
+    if p.get("quantize") == "int8" and params is not None:
+        from substratus_tpu.ops.quant import quantize_params
+
+        params = jax.jit(
+            lambda x: quantize_params(x, llama.quant_contracting(cfg))
+        )(params)
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(
+        data=int(p.get("dp", 1)),
+        fsdp=int(p.get("fsdp", -1)),
+        sequence=int(p.get("sequence", 1)),
+        tensor=int(p.get("tensor", 1)),
+    )
+    dp_total = mesh.shape["data"] * mesh.shape["fsdp"]
+    if batch_size % dp_total:
+        batch_size = ((batch_size // dp_total) + 1) * dp_total
+        print(
+            f"batch_size rounded up to {batch_size} "
+            f"(multiple of data*fsdp={dp_total})",
+            flush=True,
+        )
+    tc = TrainConfig(
+        learning_rate=float(p.get("learning_rate", 2e-5)),
+        warmup_steps=int(p.get("warmup_steps", min(10, steps // 10 + 1))),
+        total_steps=steps,
+        lora_rank=lora_rank,
+        lora_alpha=float(p.get("lora_alpha", 16.0)),
+        remat=bool(p.get("remat", True)),
+        seed=int(p.get("seed", 0)),
+    )
+    trainer = Trainer(cfg, tc, mesh, params=params)
+    data = PackedDataset(
+        args.data, tokenizer, batch_size, seq_len,
+        eos_id=getattr(tokenizer, "eos_id", 0),
+        seed=tc.seed,
+    )
+    print(
+        f"training: {n_dev} devices, mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}, "
+        f"steps={steps}, corpus={data.n_tokens} tokens, lora_rank={lora_rank}",
+        flush=True,
+    )
+
+    ckpt = CheckpointManager(
+        os.path.join(args.out, "checkpoints"),
+        save_steps=int(p.get("save_steps", max(1, steps // 5))),
+    )
+    # Preemption-safe resume (SURVEY.md §5): restore latest training state.
+    trainable = trainer.lora if trainer.lora is not None else trainer.params
+    abstract = {
+        "trainable": jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            trainable,
+        ),
+        "opt_state": jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            trainer.opt_state,
+        ),
+    }
+    resumed = ckpt.restore_latest(abstract)
+    start_step = 0
+    if resumed is not None:
+        start_step, state = resumed
+        if trainer.lora is not None:
+            trainer.lora = state["trainable"]
+        else:
+            trainer.params = state["trainable"]
+        trainer.opt_state = state["opt_state"]
+        print(f"resumed from step {start_step}", flush=True)
+
+    t0 = time.time()
+    for step in range(start_step, steps):
+        loss = trainer.train_step(next(data))
+        if step % 10 == 0 or step == steps - 1:
+            dt = time.time() - t0
+            print(f"step {step} loss {loss:.4f} ({dt:.1f}s)", flush=True)
+        trainable = trainer.lora if trainer.lora is not None else trainer.params
+        ckpt.maybe_save(
+            step + 1,
+            {"trainable": trainable, "opt_state": trainer.opt_state},
+            force=(step == steps - 1),
+        )
+    ckpt.close()
+
+    final = (
+        merge_lora(trainer.params, trainer.lora, trainer.lora_scale)
+        if trainer.lora is not None
+        else trainer.params
+    )
+    save_artifact(args.out, final, cfg, extra_meta={"trained_steps": steps})
+    print(f"artifact saved to {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

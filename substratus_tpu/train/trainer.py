@@ -20,11 +20,9 @@ docs/container-contract.md:5-56).
 """
 from __future__ import annotations
 
-import dataclasses
-import time
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +35,7 @@ from substratus_tpu.parallel.sharding import (
     DEFAULT_RULES,
     LogicalRules,
     logical_sharding,
+    shard_tree,
 )
 from substratus_tpu.train import lora as lora_lib
 
@@ -111,7 +110,10 @@ class Trainer:
             )
             params = init(key_params)
         else:
-            params = jax.tree.map(jax.device_put, params, param_sh)
+            # shard_tree handles both dense and int8-QTensor (QLoRA) bases.
+            params = shard_tree(
+                params, mesh, llama.param_logical_axes(cfg), rules
+            )
         self.params = params
         self.param_shardings = param_sh
 
@@ -207,6 +209,13 @@ class Trainer:
 
     def train_step(self, batch: Dict[str, jnp.ndarray]) -> float:
         """batch: {"tokens": [B, S] int32, "weights": [B, S] 0/1}."""
+        b = batch["tokens"].shape[0]
+        dp = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        if b % dp:
+            raise ValueError(
+                f"batch size {b} must be divisible by data*fsdp={dp} "
+                f"(mesh {dict(self.mesh.shape)})"
+            )
         batch = jax.tree.map(
             lambda x: jax.device_put(x, self.batch_sharding), batch
         )

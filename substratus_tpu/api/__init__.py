@@ -1,0 +1,37 @@
+from substratus_tpu.api.common import (
+    ArtifactsStatus,
+    Build,
+    BuildGit,
+    BuildUpload,
+    ObjectRef,
+    Resources,
+    TPUResources,
+    UploadStatus,
+    GPUResources,
+)
+from substratus_tpu.api.conditions import (
+    CONDITION_BUILT,
+    CONDITION_COMPLETE,
+    CONDITION_SERVING,
+    CONDITION_UPLOADED,
+    Condition,
+)
+from substratus_tpu.api.types import (
+    GROUP,
+    VERSION,
+    Dataset,
+    Model,
+    Notebook,
+    Server,
+    KINDS,
+    new_object,
+)
+
+__all__ = [
+    "ArtifactsStatus", "Build", "BuildGit", "BuildUpload", "ObjectRef",
+    "Resources", "TPUResources", "GPUResources", "UploadStatus",
+    "Condition", "CONDITION_BUILT", "CONDITION_COMPLETE", "CONDITION_SERVING",
+    "CONDITION_UPLOADED",
+    "GROUP", "VERSION", "Dataset", "Model", "Notebook", "Server", "KINDS",
+    "new_object",
+]

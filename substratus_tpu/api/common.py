@@ -1,0 +1,95 @@
+"""Shared CR spec/status types (reference: api/v1/common_types.go:8-111).
+
+TPU-first departure: `Resources` gains `tpu: {type, chips, topology}` — the
+north-star API change — alongside cpu/memory/disk and a gpu field kept for
+capability parity. TPU types/topologies are validated against the catalog in
+resources/accelerators.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class BuildGit:
+    """Build the container image from a git repo (ref: common_types.go Build.Git)."""
+
+    url: str = ""
+    branch: Optional[str] = None
+    path: Optional[str] = None  # subdir containing Dockerfile
+
+
+@dataclass
+class BuildUpload:
+    """Build from a client-uploaded tarball: the client sets md5 + requestID,
+    the controller answers with a signed URL in status.buildUpload."""
+
+    md5_checksum: str = ""
+    request_id: str = ""
+
+
+@dataclass
+class Build:
+    git: Optional[BuildGit] = None
+    upload: Optional[BuildUpload] = None
+
+
+@dataclass
+class UploadStatus:
+    """Signed-URL handshake state (ref: common_types.go UploadStatus)."""
+
+    signed_url: Optional[str] = None
+    request_id: Optional[str] = None
+    expiration: Optional[str] = None
+    stored_md5_checksum: Optional[str] = None
+
+
+@dataclass
+class ObjectRef:
+    name: str = ""
+    namespace: Optional[str] = None
+
+
+@dataclass
+class GPUResources:
+    """Kept for reference capability parity (a100/t4/l4 enum in
+    common_types.go:96-111); clusters targeted by this framework are
+    TPU-only but the API does not forbid GPU pools."""
+
+    type: str = ""
+    count: int = 0
+
+
+@dataclass
+class TPUResources:
+    """The TPU ask. `type` is a generation (v4, v5e, v5p, v6e), `chips` the
+    total chip count, `topology` an optional explicit slice topology like
+    "4x4" / "2x2x2"; when omitted it is derived from chips (see
+    resources/accelerators.py)."""
+
+    type: str = "v5e"
+    chips: int = 1
+    topology: Optional[str] = None
+
+
+@dataclass
+class Resources:
+    cpu: Optional[int] = None
+    disk: Optional[int] = None  # Gi
+    memory: Optional[int] = None  # Gi
+    gpu: Optional[GPUResources] = None
+    tpu: Optional[TPUResources] = None
+
+
+@dataclass
+class ArtifactsStatus:
+    url: Optional[str] = None
+
+
+@dataclass
+class Params:
+    """CR params are an arbitrary JSON object surfaced to the container as
+    /content/params.json + PARAM_* env (docs/design.md:271-281)."""
+
+    values: Dict[str, object] = field(default_factory=dict)

@@ -1,0 +1,66 @@
+"""Status condition types + reasons (reference: api/v1/conditions.go:3-32)."""
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import List, Optional
+
+CONDITION_UPLOADED = "Uploaded"
+CONDITION_BUILT = "Built"
+CONDITION_COMPLETE = "Complete"
+CONDITION_SERVING = "Serving"
+CONDITION_DEPLOYED = "Deployed"
+
+REASON_JOB_NOT_COMPLETE = "JobNotComplete"
+REASON_JOB_COMPLETE = "JobComplete"
+REASON_JOB_FAILED = "JobFailed"
+REASON_POD_READY = "PodReady"
+REASON_POD_NOT_READY = "PodNotReady"
+REASON_BUILD_JOB_RUNNING = "ContainerBuilding"
+REASON_BUILD_JOB_COMPLETE = "ContainerBuilt"
+REASON_UPLOAD_FOUND = "UploadFound"
+REASON_AWAITING_UPLOAD = "AwaitingUpload"
+REASON_MODEL_NOT_FOUND = "ModelNotFound"
+REASON_MODEL_NOT_READY = "ModelNotReady"
+REASON_DATASET_NOT_FOUND = "DatasetNotFound"
+REASON_DATASET_NOT_READY = "DatasetNotReady"
+REASON_DEPLOYMENT_READY = "DeploymentReady"
+REASON_DEPLOYMENT_NOT_READY = "DeploymentNotReady"
+REASON_SUSPENDED = "Suspended"
+
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = "False"  # "True" | "False" | "Unknown"
+    reason: Optional[str] = None
+    message: Optional[str] = None
+    last_transition_time: Optional[str] = None
+    observed_generation: Optional[int] = None
+
+
+def now() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
+def set_condition(conditions: List[Condition], new: Condition) -> List[Condition]:
+    """Upsert by type; bump lastTransitionTime only on status change
+    (metav1.SetStatusCondition semantics)."""
+    for i, c in enumerate(conditions):
+        if c.type == new.type:
+            if c.status == new.status:
+                new.last_transition_time = c.last_transition_time
+            else:
+                new.last_transition_time = new.last_transition_time or now()
+            conditions[i] = new
+            return conditions
+    new.last_transition_time = new.last_transition_time or now()
+    conditions.append(new)
+    return conditions
+
+
+def is_true(conditions: List[Condition], ctype: str) -> bool:
+    return any(c.type == ctype and c.status == "True" for c in conditions)

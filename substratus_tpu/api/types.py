@@ -1,0 +1,188 @@
+"""The four CRDs: Dataset, Model, Notebook, Server.
+
+Reference: api/v1/{dataset,model,notebook,server}_types.go. Same capability
+surface — command/image/build/resources/params specs, ready+conditions+
+artifacts status, cross-CR refs (Model->base Model/Dataset, Notebook->Model/
+Dataset, Server->Model) — expressed as Python dataclasses that serialize to
+the exact CR JSON shape (utils/serde.py).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Type
+
+from substratus_tpu.api.common import (
+    ArtifactsStatus,
+    Build,
+    ObjectRef,
+    Resources,
+    UploadStatus,
+)
+from substratus_tpu.api.conditions import Condition
+from substratus_tpu.utils.serde import from_dict, to_dict
+
+GROUP = "substratus.ai"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+
+@dataclass
+class Metadata:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    generation: int = 1
+    resource_version: str = "0"
+    uid: str = ""
+    owner_references: List[Dict[str, Any]] = field(default_factory=list)
+    creation_timestamp: Optional[str] = None
+    deletion_timestamp: Optional[str] = None
+
+
+@dataclass
+class CommonStatus:
+    ready: bool = False
+    conditions: List[Condition] = field(default_factory=list)
+    artifacts: Optional[ArtifactsStatus] = None
+    build_upload: Optional[UploadStatus] = None
+
+
+@dataclass
+class DatasetSpec:
+    """Data-loading job spec (ref: dataset_types.go:10-28)."""
+
+    command: List[str] = field(default_factory=list)
+    image: Optional[str] = None
+    build: Optional[Build] = None
+    resources: Optional[Resources] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ModelSpec:
+    """Model import/train spec (ref: model_types.go:10-36): `model` is the
+    base-model ref (finetune), `dataset` the training-data ref."""
+
+    command: List[str] = field(default_factory=list)
+    image: Optional[str] = None
+    build: Optional[Build] = None
+    resources: Optional[Resources] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    params: Dict[str, Any] = field(default_factory=dict)
+    model: Optional[ObjectRef] = None
+    dataset: Optional[ObjectRef] = None
+
+
+@dataclass
+class NotebookSpec:
+    """Jupyter dev environment (ref: notebook_types.go:10-38)."""
+
+    command: List[str] = field(default_factory=list)
+    image: Optional[str] = None
+    build: Optional[Build] = None
+    resources: Optional[Resources] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    params: Dict[str, Any] = field(default_factory=dict)
+    model: Optional[ObjectRef] = None
+    dataset: Optional[ObjectRef] = None
+    suspend: bool = False
+
+
+@dataclass
+class ServerSpec:
+    """Inference server (ref: server_types.go:10-31): `model` is required."""
+
+    command: List[str] = field(default_factory=list)
+    image: Optional[str] = None
+    build: Optional[Build] = None
+    resources: Optional[Resources] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    params: Dict[str, Any] = field(default_factory=dict)
+    model: Optional[ObjectRef] = None
+
+
+def _object_class(kind: str, spec_cls: Type) -> Type:
+    @dataclass
+    class Obj:
+        metadata: Metadata = field(default_factory=Metadata)
+        spec: spec_cls = field(default_factory=spec_cls)  # type: ignore[valid-type]
+        status: CommonStatus = field(default_factory=CommonStatus)
+
+        KIND = kind
+
+        @property
+        def name(self) -> str:
+            return self.metadata.name
+
+        @property
+        def namespace(self) -> str:
+            return self.metadata.namespace
+
+        def to_dict(self) -> Dict[str, Any]:
+            d = {
+                "apiVersion": API_VERSION,
+                "kind": kind,
+                "metadata": to_dict(self.metadata),
+                "spec": to_dict(self.spec),
+            }
+            status = to_dict(self.status)
+            # ready:false still matters; serde omits falsy, so force it.
+            status["ready"] = self.status.ready
+            d["status"] = status
+            return d
+
+        @classmethod
+        def from_dict(cls, data: Dict[str, Any]) -> "Obj":
+            obj = cls()
+            obj.metadata = from_dict(Metadata, data.get("metadata") or {}) or Metadata()
+            obj.spec = from_dict(spec_cls, data.get("spec") or {}) or spec_cls()
+            obj.status = (
+                from_dict(CommonStatus, data.get("status") or {}) or CommonStatus()
+            )
+            return obj
+
+        def deepcopy(self) -> "Obj":
+            return copy.deepcopy(self)
+
+    Obj.__name__ = kind
+    Obj.__qualname__ = kind
+    return Obj
+
+
+Dataset = _object_class("Dataset", DatasetSpec)
+Model = _object_class("Model", ModelSpec)
+Notebook = _object_class("Notebook", NotebookSpec)
+Server = _object_class("Server", ServerSpec)
+
+KINDS: Dict[str, Type] = {
+    "Dataset": Dataset,
+    "Model": Model,
+    "Notebook": Notebook,
+    "Server": Server,
+}
+
+# plural <-> kind mapping for REST paths / CLI
+PLURALS = {
+    "Dataset": "datasets",
+    "Model": "models",
+    "Notebook": "notebooks",
+    "Server": "servers",
+}
+KIND_OF_PLURAL = {v: k for k, v in PLURALS.items()}
+
+
+def new_object(kind: str, name: str, namespace: str = "default"):
+    obj = KINDS[kind]()
+    obj.metadata.name = name
+    obj.metadata.namespace = namespace
+    return obj
+
+
+def object_from_dict(data: Dict[str, Any]):
+    kind = data.get("kind")
+    if kind not in KINDS:
+        raise ValueError(f"unknown kind {kind!r}")
+    return KINDS[kind].from_dict(data)

@@ -1,8 +1,376 @@
-"""Subcommand registration for `sub`. Placeholder registry; real commands
-(apply/get/delete/run/notebook/serve) land with the controller + client
-subsystems."""
+"""`sub` subcommands (reference: internal/cli/{apply,get,delete,run,notebook,
+serve}.go).
+
+Command surface parity: apply -f, get [kind [name]], delete kind name,
+run (build-upload a local dir as a Dataset/Model and wait), notebook (dev
+loop), serve. The bubbletea TUI becomes plain terminal progress output; the
+flows (tar+md5 -> apply CR with build.upload -> wait for signed URL -> PUT ->
+wait ready) are the same (reference internal/tui/upload.go:92-140,
+internal/client/upload.go:38-192).
+
+`--fake` runs every command against an in-process fake apiserver +
+controller manager (kube/fake.py) — the local dev loop without a cluster.
+"""
 from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tarfile
+import time
+import urllib.request
+import uuid
+from typing import Optional
+
+import yaml
+
+from substratus_tpu.api.types import KIND_OF_PLURAL, KINDS, PLURALS
+
+_FAKE_ENV = None
+
+
+def _client(args):
+    """Build a KubeClient: real (kubeconfig/in-cluster) or fake."""
+    global _FAKE_ENV
+    if getattr(args, "fake", False):
+        if _FAKE_ENV is None:
+            from substratus_tpu.cli.fake_env import FakeEnv
+
+            _FAKE_ENV = FakeEnv()
+        return _FAKE_ENV.client
+    from substratus_tpu.kube.real import RealKube
+
+    kubeconfig = os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+    if os.path.exists("/var/run/secrets/kubernetes.io/serviceaccount/token"):
+        return RealKube.in_cluster()
+    if os.path.exists(kubeconfig):
+        with open(kubeconfig) as f:
+            kc = yaml.safe_load(f)
+        ctx_name = kc.get("current-context")
+        ctx = next(c for c in kc["contexts"] if c["name"] == ctx_name)["context"]
+        cluster = next(
+            c for c in kc["clusters"] if c["name"] == ctx["cluster"]
+        )["cluster"]
+        user = next(u for u in kc["users"] if u["name"] == ctx["user"])["user"]
+        ca_file = cluster.get("certificate-authority")
+        if cluster.get("certificate-authority-data"):
+            import base64
+            import tempfile
+
+            ca_tmp = tempfile.NamedTemporaryFile(
+                suffix=".crt", delete=False, mode="wb"
+            )
+            ca_tmp.write(
+                base64.b64decode(cluster["certificate-authority-data"])
+            )
+            ca_tmp.close()
+            ca_file = ca_tmp.name
+        return RealKube(
+            cluster["server"],
+            token=user.get("token"),
+            ca_file=ca_file,
+            verify=not cluster.get("insecure-skip-tls-verify", False),
+        )
+    raise SystemExit("no kubeconfig found and not in-cluster (try --fake)")
+
+
+def _load_manifests(path: str):
+    docs = []
+    skipped = []
+    paths = []
+    if os.path.isdir(path):
+        for f in sorted(os.listdir(path)):
+            if f.endswith((".yaml", ".yml")):
+                paths.append(os.path.join(path, f))
+    else:
+        paths = [path]
+    for p in paths:
+        with open(p) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc and doc.get("kind") in KINDS:
+                    docs.append(doc)
+                elif doc:
+                    skipped.append((p, doc.get("kind")))
+    for p, kind in skipped:
+        print(
+            f"warning: skipping non-substratus doc in {p} (kind={kind!r})",
+            file=sys.stderr,
+        )
+    if not docs and not os.path.isdir(path):
+        raise SystemExit(f"no substratus manifests in {path}")
+    return docs
+
+
+def _norm_kind(kind: str) -> str:
+    k = kind.rstrip("s").title() if kind.lower() in KIND_OF_PLURAL else kind.title()
+    if k not in KINDS:
+        k = KIND_OF_PLURAL.get(kind.lower(), kind)
+    if k not in KINDS:
+        raise SystemExit(f"unknown kind {kind!r} (known: {', '.join(KINDS)})")
+    return k
+
+
+def _wait_ready(client, kind, ns, name, timeout=720, fake=False):
+    """Poll status.ready (reference client.go:114-135 WaitReady; the 720s
+    budget mirrors test/system.sh:53-54)."""
+    t0 = time.time()
+    last_msg = ""
+    while time.time() - t0 < timeout:
+        if fake and _FAKE_ENV is not None:
+            _FAKE_ENV.step()
+        obj = client.get_or_none(kind, ns, name)
+        if obj and obj.get("status", {}).get("ready"):
+            print(f"{kind} {name} ready")
+            return obj
+        conds = (obj or {}).get("status", {}).get("conditions", [])
+        msg = "; ".join(
+            f"{c['type']}={c['status']}({c.get('reason', '')})" for c in conds
+        )
+        if msg != last_msg:
+            print(f"  waiting: {msg or 'no status yet'}")
+            last_msg = msg
+        time.sleep(0.1 if fake else 2)
+    raise SystemExit(f"timed out waiting for {kind} {name}")
+
+
+# -- commands --------------------------------------------------------------
+
+
+def cmd_apply(args) -> int:
+    client = _client(args)
+    for doc in _load_manifests(args.filename):
+        doc.setdefault("metadata", {}).setdefault("namespace", args.namespace)
+        out = client.apply(doc)
+        print(f"{out['kind'].lower()}.substratus.ai/{out['metadata']['name']} applied")
+        if args.wait:
+            _wait_ready(
+                client, out["kind"], out["metadata"]["namespace"],
+                out["metadata"]["name"], fake=args.fake,
+            )
+    return 0
+
+
+def cmd_get(args) -> int:
+    client = _client(args)
+    kinds = [_norm_kind(args.kind)] if args.kind else list(KINDS)
+    rows = []
+    for kind in kinds:
+        for obj in client.list(kind, args.namespace):
+            if args.name and obj["metadata"]["name"] != args.name:
+                continue
+            conds = obj.get("status", {}).get("conditions", [])
+            latest = conds[-1]["reason"] if conds else ""
+            rows.append(
+                (
+                    PLURALS[kind],
+                    obj["metadata"]["name"],
+                    str(obj.get("status", {}).get("ready", False)).lower(),
+                    latest or "",
+                )
+            )
+    if not rows:
+        print("no resources found")
+        return 0
+    widths = [max(len(r[i]) for r in rows + [("KIND", "NAME", "READY", "STATUS")]) for i in range(4)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format("KIND", "NAME", "READY", "STATUS"))
+    for r in rows:
+        print(fmt.format(*r))
+    return 0
+
+
+def cmd_delete(args) -> int:
+    client = _client(args)
+    kind = _norm_kind(args.kind)
+    client.delete(kind, args.namespace, args.name)
+    print(f"{kind.lower()}.substratus.ai/{args.name} deleted")
+    return 0
+
+
+class _HashingFile:
+    """File wrapper feeding an incremental md5 as bytes are written."""
+
+    def __init__(self, f):
+        self.f = f
+        self.md5 = hashlib.md5()
+
+    def write(self, data):
+        self.md5.update(data)
+        return self.f.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self.f, name)
+
+
+def _tarball(directory: str):
+    """tar.gz a build context to a tempfile with incremental md5 — build
+    contexts can be multi-GB, so never buffer in RAM (reference
+    client/upload.go:38-68). Returns (path, md5_hex, md5_b64, size)."""
+    import base64
+    import tempfile
+
+    if not os.path.exists(os.path.join(directory, "Dockerfile")):
+        raise SystemExit(f"no Dockerfile in {directory}")
+    tmp = tempfile.NamedTemporaryFile(
+        suffix=".tar.gz", delete=False, mode="wb"
+    )
+    hasher = _HashingFile(tmp)
+    with tarfile.open(fileobj=hasher, mode="w:gz") as tar:
+        for root, dirs, files in os.walk(directory):
+            dirs[:] = [d for d in dirs if not d.startswith(".")]
+            for f in files:
+                full = os.path.join(root, f)
+                tar.add(full, arcname=os.path.relpath(full, directory))
+    tmp.close()
+    digest = hasher.md5.digest()
+    return (
+        tmp.name,
+        hasher.md5.hexdigest(),
+        base64.b64encode(digest).decode(),
+        os.path.getsize(tmp.name),
+    )
+
+
+def cmd_run(args) -> int:
+    """Upload the current dir and run it as a Dataset or Model (reference
+    internal/cli/run.go:16-104)."""
+    client = _client(args)
+    tar_path, md5, md5_b64, size = _tarball(args.dir)
+    docs = _load_manifests(args.filename) if args.filename else []
+    if not docs:
+        raise SystemExit("run requires -f manifest describing the Dataset/Model")
+    doc = docs[0]
+    request_id = uuid.uuid4().hex
+    doc.setdefault("metadata", {}).setdefault("namespace", args.namespace)
+    doc.setdefault("spec", {})["build"] = {
+        "upload": {"md5Checksum": md5, "requestId": request_id}
+    }
+    obj = client.apply(doc)
+    kind, name = obj["kind"], obj["metadata"]["name"]
+    ns = obj["metadata"]["namespace"]
+    print(f"{kind.lower()}/{name} applied (upload {size} bytes, md5 {md5})")
+
+    # Wait for our signed URL (reference upload.go:126-178).
+    url = None
+    for _ in range(300):
+        if args.fake and _FAKE_ENV is not None:
+            _FAKE_ENV.step()
+        live = client.get(kind, ns, name)
+        bu = live.get("status", {}).get("buildUpload", {})
+        if bu.get("requestId") == request_id and bu.get("signedUrl"):
+            url = bu["signedUrl"]
+            break
+        time.sleep(0.1 if args.fake else 2)
+    if url is None:
+        raise SystemExit("controller never published a signed upload URL")
+
+    try:
+        if args.fake and _FAKE_ENV is not None:
+            with open(tar_path, "rb") as f:
+                _FAKE_ENV.accept_upload(f.read(), md5)
+            print("uploaded to fake storage")
+        else:
+            with open(tar_path, "rb") as f:
+                req = urllib.request.Request(
+                    url, data=f, method="PUT",
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        # Signed URLs are md5-bound; storage rejects a PUT
+                        # without the matching header (reference
+                        # client/upload.go:337, sci/kind/server.go:39).
+                        "Content-MD5": md5_b64,
+                        "Content-Length": str(size),
+                    },
+                )
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    r.read()
+            print(f"uploaded ({r.status})")
+            # nudge the controller (reference upload.go:184-189)
+            live = client.get(kind, ns, name)
+            live["metadata"].setdefault("annotations", {})[
+                "substratus.ai/upload-timestamp"
+            ] = str(time.time())
+            client.update(live)
+    finally:
+        os.unlink(tar_path)
+
+    _wait_ready(client, kind, ns, name, fake=args.fake)
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Run the serving container locally (reference `sub serve`)."""
+    from substratus_tpu.serve.main import main as serve_main
+
+    argv = []
+    if args.model:
+        argv += ["--model", args.model]
+    if args.config:
+        argv += ["--config", args.config]
+    argv += ["--port", str(args.port)]
+    return serve_main(argv)
+
+
+def cmd_notebook(args) -> int:
+    from substratus_tpu.cli.notebook import run_notebook
+
+    return run_notebook(args, _client(args))
+
+
+def cmd_version(args) -> int:
+    from substratus_tpu import __version__
+
+    print(f"sub {__version__}")
+    return 0
 
 
 def register(sub) -> None:
-    pass
+    def common(p):
+        p.add_argument("-n", "--namespace", default="default")
+        p.add_argument(
+            "--fake", action="store_true",
+            help="in-process fake cluster (local dev)",
+        )
+
+    p = sub.add_parser("apply", help="apply substratus manifests")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--wait", action="store_true", help="wait for ready")
+    common(p)
+    p.set_defaults(func=cmd_apply)
+
+    p = sub.add_parser("get", help="list substratus objects")
+    p.add_argument("kind", nargs="?")
+    p.add_argument("name", nargs="?")
+    common(p)
+    p.set_defaults(func=cmd_get)
+
+    p = sub.add_parser("delete", help="delete an object")
+    p.add_argument("kind")
+    p.add_argument("name")
+    common(p)
+    p.set_defaults(func=cmd_delete)
+
+    p = sub.add_parser(
+        "run", help="upload current dir + run as Dataset/Model"
+    )
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("-d", "--dir", default=".")
+    common(p)
+    p.set_defaults(func=cmd_run)
+
+    p = sub.add_parser("notebook", help="launch a notebook dev environment")
+    p.add_argument("-f", "--filename", default=".")
+    p.add_argument("--no-open", action="store_true")
+    common(p)
+    p.set_defaults(func=cmd_notebook)
+
+    p = sub.add_parser("serve", help="serve a model locally")
+    p.add_argument("--model")
+    p.add_argument("--config")
+    p.add_argument("--port", type=int, default=8080)
+    p.set_defaults(func=cmd_serve)
+
+    p = sub.add_parser("version", help="print version")
+    p.set_defaults(func=cmd_version)

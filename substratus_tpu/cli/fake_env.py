@@ -1,0 +1,113 @@
+"""In-process fake cluster for `sub --fake`: fake apiserver + manager +
+fake data plane.
+
+The reference needs a kind cluster even for local smoke (install/kind/up.sh);
+`--fake` gives the same control-plane behavior with zero infrastructure.
+The data-plane simulation completes Jobs/Deployments a moment after they
+appear — enough to exercise CR flows end to end from the CLI.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from substratus_tpu.cloud.base import LocalCloud
+from substratus_tpu.cloud.common import CommonConfig
+from substratus_tpu.controller.manager_main import build_manager
+from substratus_tpu.kube.fake import FakeKube
+from substratus_tpu.sci.client import FakeSCIClient
+
+STATE_FILE = os.environ.get(
+    "SUBSTRATUS_FAKE_STATE", "/tmp/substratus-fake-cluster.json"
+)
+
+
+class FakeEnv:
+    """State persists to STATE_FILE so sequential `sub --fake` invocations
+    (apply, then get, then delete) see one continuous cluster."""
+
+    def __init__(self):
+        self.client = FakeKube()
+        self._load()
+        self.client.add_listener(lambda *_: self._save())
+        self.cloud = LocalCloud(
+            CommonConfig(
+                cluster_name="fake",
+                artifact_bucket_url="local:///tmp/substratus-bucket",
+                registry_url="registry.fake:5000",
+            )
+        )
+        self.sci = FakeSCIClient()
+        self.manager = build_manager(self.client, self.cloud, self.sci)
+        self.manager.bootstrap()
+
+    def _load(self) -> None:
+        if not os.path.exists(STATE_FILE):
+            return
+        try:
+            with open(STATE_FILE) as f:
+                state = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return
+        for obj in state.get("objects", []):
+            key = self.client._key(
+                obj["kind"],
+                obj["metadata"].get("namespace", "default"),
+                obj["metadata"]["name"],
+            )
+            self.client._store[key] = obj
+        self.client._rv = state.get("rv", len(state.get("objects", [])))
+        self.client._uid = state.get("uid", self.client._rv)
+
+    def _save(self) -> None:
+        state = {
+            "objects": list(self.client._store.values()),
+            "rv": self.client._rv,
+            "uid": self.client._uid,
+        }
+        tmp = STATE_FILE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, STATE_FILE)
+
+    def step(self) -> None:
+        """One control-plane + data-plane tick."""
+        self.manager.run_until_idle()
+        # Fake kubelet: everything created eventually runs/succeeds.
+        for job in self.client.list("Job"):
+            if not job.get("status"):
+                self.client.mark_job_complete(
+                    job["metadata"]["namespace"], job["metadata"]["name"]
+                )
+        for js in self.client.list("JobSet"):
+            if not js.get("status"):
+                self.client.mark_jobset_complete(
+                    js["metadata"]["namespace"], js["metadata"]["name"]
+                )
+        for dep in self.client.list("Deployment"):
+            if not dep.get("status"):
+                self.client.mark_deployment_ready(
+                    dep["metadata"]["namespace"], dep["metadata"]["name"]
+                )
+        for pod in self.client.list("Pod"):
+            if not pod.get("status"):
+                self.client.mark_pod_ready(
+                    pod["metadata"]["namespace"], pod["metadata"]["name"]
+                )
+        self.manager.run_until_idle()
+
+    def accept_upload(self, data: bytes, md5: str) -> None:
+        """Simulate the storage side of the signed-URL PUT: register the
+        stored md5 for every pending upload object that expects it."""
+        for kind in ("Dataset", "Model", "Notebook", "Server"):
+            for obj in self.client.list(kind):
+                up = (obj.get("spec", {}).get("build") or {}).get("upload")
+                if up and up.get("md5Checksum") == md5:
+                    md = obj["metadata"]
+                    path = (
+                        f"uploads/{md['namespace']}/{kind.lower()}s/"
+                        f"{md['name']}/{md5}.tar.gz"
+                    )
+                    self.sci.md5s[path] = hashlib.md5(data).hexdigest()

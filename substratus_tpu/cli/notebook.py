@@ -1,0 +1,73 @@
+"""`sub notebook` dev loop (reference: internal/cli/notebook.go +
+internal/tui/notebook.go:65-91 compose manifests->upload->readiness->
+port-forward->browser; internal/client/notebook.go:20-86 converts
+Model/Server/Dataset manifests into Notebooks).
+
+Terminal (non-TUI) rendition of the same flow. Port-forward/file-sync need a
+real cluster; under --fake the flow stops after readiness.
+"""
+from __future__ import annotations
+
+import os
+import time
+import webbrowser
+from typing import Optional
+
+from substratus_tpu.api.types import KINDS
+
+
+def notebook_for_object(doc: dict) -> dict:
+    """Convert a Model/Server/Dataset manifest to a Notebook (reference
+    client/notebook.go:20-86): same image/build/resources/params, refs
+    carried over."""
+    kind = doc.get("kind")
+    spec = doc.get("spec", {})
+    nb_spec = {
+        k: spec[k]
+        for k in ("image", "build", "resources", "params", "env")
+        if k in spec
+    }
+    if kind == "Model":
+        for k in ("model", "dataset"):
+            if k in spec:
+                nb_spec[k] = spec[k]
+    elif kind == "Server":
+        if "model" in spec:
+            nb_spec["model"] = spec["model"]
+    elif kind == "Dataset":
+        pass
+    return {
+        "apiVersion": "substratus.ai/v1",
+        "kind": "Notebook",
+        "metadata": dict(doc.get("metadata", {})),
+        "spec": nb_spec,
+    }
+
+
+def run_notebook(args, client) -> int:
+    from substratus_tpu.cli.commands import _load_manifests, _wait_ready, _FAKE_ENV
+
+    docs = _load_manifests(args.filename)
+    if not docs:
+        raise SystemExit(f"no substratus manifests under {args.filename}")
+    # Prefer an explicit Notebook, else convert (kind preference mirrors
+    # reference tui/notebook.go:66-71).
+    doc = next((d for d in docs if d["kind"] == "Notebook"), None)
+    if doc is None:
+        doc = notebook_for_object(docs[0])
+    doc["metadata"].setdefault("namespace", args.namespace)
+    doc["spec"]["suspend"] = False
+    obj = client.apply(doc)
+    name = obj["metadata"]["name"]
+    ns = obj["metadata"]["namespace"]
+    print(f"notebook.substratus.ai/{name} applied")
+    _wait_ready(client, "Notebook", ns, name, fake=args.fake)
+
+    if args.fake:
+        print("fake mode: skipping port-forward/browser")
+        return 0
+    url = f"http://localhost:8888?token=default"
+    print(f"notebook ready; port-forward pod/{name}-notebook 8888 and open {url}")
+    if not args.no_open:
+        webbrowser.open(url)
+    return 0

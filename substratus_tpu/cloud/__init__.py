@@ -1,0 +1,4 @@
+from substratus_tpu.cloud.base import Cloud, new_cloud
+from substratus_tpu.cloud.common import artifact_url, image_url, object_hash
+
+__all__ = ["Cloud", "new_cloud", "artifact_url", "image_url", "object_hash"]

@@ -1,0 +1,159 @@
+"""Cloud abstraction (reference: internal/cloud/cloud.go:20-85).
+
+Same interface surface: name, auto-configure, image/artifact addressing,
+principal association, bucket mounting. Implementations: `gcp` (GKE + GCS
+FUSE + workload identity + TPU slices) and `local` (hostPath bucket for kind
+clusters and tests — the reference's `kind` cloud)."""
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional
+
+from substratus_tpu.cloud.common import CommonConfig, artifact_url, image_url
+
+
+class Cloud(ABC):
+    def __init__(self, cfg: Optional[CommonConfig] = None):
+        self.cfg = cfg or CommonConfig()
+
+    @property
+    @abstractmethod
+    def name(self) -> str: ...
+
+    def auto_configure(self) -> None:
+        """Fill config from the environment/metadata where possible."""
+
+    def object_built_image_url(self, obj) -> str:
+        return image_url(self.cfg, obj.namespace, obj.KIND, obj.name)
+
+    def object_artifact_url(self, obj) -> str:
+        return artifact_url(self.cfg, obj.namespace, obj.KIND, obj.name)
+
+    @abstractmethod
+    def associate_principal(self, sa_namespace: str, sa_name: str) -> str:
+        """Returns the cloud principal bound to a k8s ServiceAccount."""
+
+    @abstractmethod
+    def mount_bucket(
+        self,
+        pod_metadata: Dict[str, Any],
+        pod_spec: Dict[str, Any],
+        container: Dict[str, Any],
+        name: str,
+        bucket_url: str,
+        mounts: Dict[str, str],  # subpath-in-bucket -> container path
+        read_only: bool = True,
+    ) -> None:
+        """Attach bucket storage to a pod at /content/* paths."""
+
+
+class GCPCloud(Cloud):
+    """GKE: GCS-FUSE CSI mounts + workload identity annotations
+    (reference gcp.go:28-140)."""
+
+    @property
+    def name(self) -> str:
+        return "gcp"
+
+    def __init__(self, cfg: Optional[CommonConfig] = None):
+        super().__init__(cfg)
+        self.project_id = os.environ.get("PROJECT_ID", "")
+
+    def auto_configure(self) -> None:
+        # In-cluster this would consult the GCE metadata server; env wins.
+        self.project_id = os.environ.get("PROJECT_ID", self.project_id)
+
+    def associate_principal(self, sa_namespace: str, sa_name: str) -> str:
+        return (
+            f"{self.cfg.cluster_name}-{sa_namespace}-{sa_name}@"
+            f"{self.project_id}.iam.gserviceaccount.com"
+        )
+
+    def workload_identity_annotation(self, principal: str) -> Dict[str, str]:
+        return {"iam.gke.io/gcp-service-account": principal}
+
+    def mount_bucket(self, pod_metadata, pod_spec, container, name,
+                     bucket_url, mounts, read_only=True) -> None:
+        bucket, _, prefix = bucket_url.removeprefix("gs://").partition("/")
+        pod_metadata.setdefault("annotations", {}).update(
+            {
+                "gke-gcsfuse/volumes": "true",
+                "gke-gcsfuse/cpu-limit": "2",
+                "gke-gcsfuse/memory-limit": "2Gi",
+                "gke-gcsfuse/ephemeral-storage-limit": "10Gi",
+            }
+        )
+        pod_spec.setdefault("volumes", []).append(
+            {
+                "name": name,
+                "csi": {
+                    "driver": "gcsfuse.csi.storage.gke.io",
+                    "readOnly": read_only,
+                    "volumeAttributes": {
+                        "bucketName": bucket,
+                        "mountOptions": "implicit-dirs,uid=0,gid=0",
+                    },
+                },
+            }
+        )
+        for sub, target in mounts.items():
+            container.setdefault("volumeMounts", []).append(
+                {
+                    "name": name,
+                    "mountPath": target,
+                    "subPath": f"{prefix}/{sub}".lstrip("/"),
+                    "readOnly": read_only,
+                }
+            )
+
+
+class LocalCloud(Cloud):
+    """hostPath `/bucket` as the artifact store with a `tar://`-style local
+    scheme (reference kind.go:23-94); identity operations are no-ops. Used by
+    kind clusters and the controller test suite."""
+
+    @property
+    def name(self) -> str:
+        return "local"
+
+    def __init__(self, cfg: Optional[CommonConfig] = None, root: str = "/bucket"):
+        cfg = cfg or CommonConfig()
+        if not cfg.artifact_bucket_url:
+            cfg.artifact_bucket_url = f"local://{root}"
+        if not cfg.registry_url:
+            cfg.registry_url = "registry.local:5000"
+        super().__init__(cfg)
+        self.root = root
+
+    def associate_principal(self, sa_namespace: str, sa_name: str) -> str:
+        return f"local-{sa_namespace}-{sa_name}"
+
+    def mount_bucket(self, pod_metadata, pod_spec, container, name,
+                     bucket_url, mounts, read_only=True) -> None:
+        path = bucket_url.removeprefix("local://")
+        pod_spec.setdefault("volumes", []).append(
+            {"name": name, "hostPath": {"path": path, "type": "DirectoryOrCreate"}}
+        )
+        for sub, target in mounts.items():
+            container.setdefault("volumeMounts", []).append(
+                {
+                    "name": name,
+                    "mountPath": target,
+                    "subPath": sub,
+                    "readOnly": read_only,
+                }
+            )
+
+
+def new_cloud(name: Optional[str] = None) -> Cloud:
+    """Factory (reference cloud.go:48-85): CLOUD env, else local."""
+    name = name or os.environ.get("CLOUD", "").lower() or "local"
+    if name == "gcp":
+        c: Cloud = GCPCloud()
+    elif name in ("local", "kind"):
+        c = LocalCloud()
+    else:
+        raise ValueError(f"unknown cloud {name!r} (known: gcp, local)")
+    c.auto_configure()
+    return c

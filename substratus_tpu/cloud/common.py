@@ -1,0 +1,58 @@
+"""Deterministic artifact/image addressing (reference: internal/cloud/
+common.go:18-66; rationale docs/design.md:80-137).
+
+Artifacts and images are addressed by *identity* (cluster/namespace/kind/
+name), not content: re-applying the same CR into a fresh cluster with an
+existing bucket finds its prior outputs. The bucket path hashes the identity
+string so paths stay short and uniform.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CommonConfig:
+    """Env-driven operator config (reference common.go:11-16, envFrom the
+    `system` ConfigMap)."""
+
+    cluster_name: str = field(
+        default_factory=lambda: os.environ.get("CLUSTER_NAME", "default")
+    )
+    artifact_bucket_url: str = field(
+        default_factory=lambda: os.environ.get("ARTIFACT_BUCKET_URL", "")
+    )
+    registry_url: str = field(
+        default_factory=lambda: os.environ.get("REGISTRY_URL", "")
+    )
+    principal: str = field(
+        default_factory=lambda: os.environ.get("PRINCIPAL", "")
+    )
+
+    def validate(self) -> None:
+        missing = [
+            k
+            for k in ("artifact_bucket_url", "registry_url")
+            if not getattr(self, k)
+        ]
+        if missing:
+            raise ValueError(f"missing cloud config: {missing}")
+
+
+def object_hash(cluster: str, namespace: str, kind: str, name: str) -> str:
+    """md5 of the identity path (reference common.go:45-66)."""
+    ident = f"clusters/{cluster}/namespaces/{namespace}/{kind.lower()}s/{name}"
+    return hashlib.md5(ident.encode()).hexdigest()
+
+
+def artifact_url(cfg: CommonConfig, namespace: str, kind: str, name: str) -> str:
+    h = object_hash(cfg.cluster_name, namespace, kind, name)
+    return f"{cfg.artifact_bucket_url.rstrip('/')}/{h}"
+
+
+def image_url(cfg: CommonConfig, namespace: str, kind: str, name: str) -> str:
+    """registry/cluster-kind-ns-name:latest (reference common.go:18-43)."""
+    tag = f"{cfg.cluster_name}-{kind.lower()}-{namespace}-{name}"
+    return f"{cfg.registry_url.rstrip('/')}/{tag}:latest"

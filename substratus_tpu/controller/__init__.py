@@ -1,0 +1,3 @@
+from substratus_tpu.controller.runtime import Manager, Result
+
+__all__ = ["Manager", "Result"]

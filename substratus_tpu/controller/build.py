@@ -1,0 +1,242 @@
+"""Shared image-build reconciler for all four CR kinds (reference:
+internal/controller/build_reconciler.go:31-574).
+
+Flow parity:
+  * skip unless the CR has spec.build and spec.image != the deterministic
+    built-image URL (build_reconciler.go:67-72);
+  * upload builds: signed-URL handshake — controller publishes a signed PUT
+    URL for the client's {md5, requestID} in status.buildUpload, waits until
+    storage MD5 matches, then builds (183-268);
+  * git builds: clone-and-build Job (270-403);
+  * the build Job is annotated with its target image and recreated when the
+    target changes (117-136);
+  * on success: spec.image <- built URL, condition Built=True (157-171).
+
+The builder pod runs kaniko exactly like the reference — image building is
+cloud machinery, not accelerator work, so the same tool is the right call.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from substratus_tpu.api import conditions as C
+from substratus_tpu.cloud.base import Cloud
+from substratus_tpu.controller.common import (
+    SA_CONTAINER_BUILDER,
+    job_state,
+    reconcile_child,
+    reconcile_service_account,
+    set_condition,
+    write_status,
+)
+from substratus_tpu.controller.runtime import Result
+from substratus_tpu.controller.workloads import owner_reference
+from substratus_tpu.kube.client import KubeClient, NotFound, Obj
+from substratus_tpu.resources.apply import builder_resources
+from substratus_tpu.sci.client import SCIClient
+
+KANIKO_IMAGE = "gcr.io/kaniko-project/executor:latest"
+GIT_IMAGE = "alpine/git:latest"
+UPLOAD_OBJECT_PREFIX = "uploads"
+
+
+class BuildReconciler:
+    def __init__(self, client: KubeClient, cloud: Cloud, sci: SCIClient):
+        self.client = client
+        self.cloud = cloud
+        self.sci = sci
+
+    def __call__(self, obj: Obj) -> Result:
+        spec = obj.get("spec") or {}
+        build = spec.get("build")
+        if not build:
+            return Result()
+
+        class _Ref:
+            KIND = obj["kind"]
+            name = obj["metadata"]["name"]
+            namespace = obj["metadata"]["namespace"]
+
+        target_image = self.cloud.object_built_image_url(_Ref)
+        if spec.get("image") == target_image:
+            return Result()  # already built
+
+        md = obj["metadata"]
+        ns = md["namespace"]
+
+        if build.get("upload"):
+            result = self._reconcile_upload(obj, build["upload"], target_image)
+            if result is not None:
+                return result
+
+        reconcile_service_account(
+            self.client, self.cloud, self.sci, ns, SA_CONTAINER_BUILDER
+        )
+
+        job_name = f"{md['name']}-{obj['kind'].lower()}-bld"
+        desired = self._build_job(obj, build, job_name, target_image)
+        try:
+            job = self.client.get("Job", ns, job_name)
+            if (
+                job["metadata"].get("annotations", {}).get("image")
+                != target_image
+            ):
+                # Target moved (e.g. new upload): recreate (ref :117-136).
+                self.client.delete("Job", ns, job_name)
+                job = self.client.create(desired)
+        except NotFound:
+            job = self.client.create(desired)
+
+        state = job_state(job)
+        if state == "complete":
+            set_condition(
+                obj, C.CONDITION_BUILT, True, C.REASON_BUILD_JOB_COMPLETE
+            )
+            write_status(self.client, obj)
+            fresh = self.client.get(obj["kind"], ns, md["name"])
+            fresh["spec"]["image"] = target_image
+            self.client.update(fresh)
+            obj["spec"]["image"] = target_image
+        elif state == "failed":
+            set_condition(
+                obj, C.CONDITION_BUILT, False, C.REASON_JOB_FAILED,
+                f"build job {job_name} failed",
+            )
+            write_status(self.client, obj)
+        else:
+            set_condition(
+                obj, C.CONDITION_BUILT, False, C.REASON_BUILD_JOB_RUNNING
+            )
+            write_status(self.client, obj)
+        return Result()
+
+    # -- upload handshake --------------------------------------------------
+
+    def _upload_object_path(self, obj: Obj, md5: str) -> str:
+        md = obj["metadata"]
+        return (
+            f"{UPLOAD_OBJECT_PREFIX}/{md['namespace']}/"
+            f"{obj['kind'].lower()}s/{md['name']}/{md5}.tar.gz"
+        )
+
+    def _reconcile_upload(
+        self, obj: Obj, upload: dict, target_image: str
+    ) -> Optional[Result]:
+        """Returns None when the upload is verified (build may proceed)."""
+        md5 = upload.get("md5Checksum", "")
+        request_id = upload.get("requestId", "")
+        status_upload = obj.setdefault("status", {}).setdefault(
+            "buildUpload", {}
+        )
+        object_path = self._upload_object_path(obj, md5)
+
+        stored = self.sci.get_object_md5(
+            self.cloud.cfg.artifact_bucket_url, object_path
+        )
+        if stored == md5:
+            set_condition(
+                obj, C.CONDITION_UPLOADED, True, C.REASON_UPLOAD_FOUND
+            )
+            status_upload["storedMd5Checksum"] = stored
+            write_status(self.client, obj)
+            return None
+
+        if status_upload.get("requestId") != request_id or not status_upload.get(
+            "signedUrl"
+        ):
+            signed = self.sci.create_signed_url(
+                self.cloud.cfg.artifact_bucket_url, object_path, md5
+            )
+            status_upload.update(
+                {"signedUrl": signed.url, "requestId": request_id}
+            )
+        set_condition(
+            obj, C.CONDITION_UPLOADED, False, C.REASON_AWAITING_UPLOAD
+        )
+        write_status(self.client, obj)
+        # Poll storage until the client PUT lands (the client also patches an
+        # annotation to requeue us immediately, reference upload.go:184-189).
+        return Result(requeue_after=10.0)
+
+    # -- build job ---------------------------------------------------------
+
+    def _build_job(
+        self, obj: Obj, build: dict, job_name: str, target_image: str
+    ) -> Obj:
+        md = obj["metadata"]
+        init_containers = []
+        volumes = [{"name": "workspace", "emptyDir": {}}]
+        kaniko_args = [
+            "--dockerfile=Dockerfile",
+            "--context=dir:///workspace",
+            f"--destination={target_image}",
+        ]
+        if build.get("git"):
+            git = build["git"]
+            clone = ["git", "clone", "--depth=1"]
+            if git.get("branch"):
+                clone += ["--branch", git["branch"]]
+            clone += [git["url"], "/workspace/repo"]
+            init_containers.append(
+                {
+                    "name": "clone",
+                    "image": GIT_IMAGE,
+                    "command": clone,
+                    "volumeMounts": [
+                        {"name": "workspace", "mountPath": "/workspace"}
+                    ],
+                }
+            )
+            ctx = "/workspace/repo"
+            if git.get("path"):
+                ctx = f"{ctx}/{git['path']}"
+            kaniko_args[1] = f"--context=dir://{ctx}"
+        else:
+            upload = build.get("upload") or {}
+            object_path = self._upload_object_path(
+                obj, upload.get("md5Checksum", "")
+            )
+            kaniko_args[1] = (
+                "--context="
+                f"{self.cloud.cfg.artifact_bucket_url.rstrip('/')}/{object_path}"
+            )
+        return {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {
+                "name": job_name,
+                "namespace": md["namespace"],
+                "annotations": {"image": target_image},
+                "ownerReferences": [owner_reference(obj)],
+            },
+            "spec": {
+                "backoffLimit": 2,
+                "template": {
+                    "metadata": {
+                        "annotations": {
+                            "kubectl.kubernetes.io/default-container": "kaniko"
+                        }
+                    },
+                    "spec": {
+                        "serviceAccountName": SA_CONTAINER_BUILDER,
+                        "restartPolicy": "Never",
+                        "initContainers": init_containers,
+                        "containers": [
+                            {
+                                "name": "kaniko",
+                                "image": KANIKO_IMAGE,
+                                "args": kaniko_args,
+                                "resources": builder_resources(),
+                                "volumeMounts": [
+                                    {
+                                        "name": "workspace",
+                                        "mountPath": "/workspace",
+                                    }
+                                ],
+                            }
+                        ],
+                        "volumes": volumes,
+                    },
+                },
+            },
+        }

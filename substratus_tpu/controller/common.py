@@ -1,0 +1,138 @@
+"""Shared reconciler helpers (reference: internal/controller/utils.go,
+service_accounts_controller.go)."""
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional
+
+from substratus_tpu.cloud.base import Cloud
+from substratus_tpu.kube.client import KubeClient, NotFound, Obj
+from substratus_tpu.sci.client import SCIClient
+
+BOUND_ANNOTATION = "substratus.ai/identity-bound"
+PRINCIPAL_ANNOTATION = "iam.gke.io/gcp-service-account"
+
+# Per-workload service accounts (reference service_accounts_controller.go:16-22).
+SA_CONTAINER_BUILDER = "container-builder"
+SA_MODELLER = "modeller"
+SA_MODEL_SERVER = "model-server"
+SA_NOTEBOOK = "notebook"
+SA_DATA_LOADER = "data-loader"
+
+
+def utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def get_conditions(obj: Obj) -> List[Dict[str, Any]]:
+    return obj.setdefault("status", {}).setdefault("conditions", [])
+
+
+def set_condition(
+    obj: Obj, ctype: str, status: bool, reason: str, message: str = ""
+) -> None:
+    conds = get_conditions(obj)
+    new = {
+        "type": ctype,
+        "status": "True" if status else "False",
+        "reason": reason,
+        "message": message,
+        "observedGeneration": obj.get("metadata", {}).get("generation"),
+    }
+    for i, c in enumerate(conds):
+        if c.get("type") == ctype:
+            new["lastTransitionTime"] = (
+                c.get("lastTransitionTime")
+                if c.get("status") == new["status"]
+                else utcnow()
+            )
+            conds[i] = new
+            return
+    new["lastTransitionTime"] = utcnow()
+    conds.append(new)
+
+
+def condition_true(obj: Obj, ctype: str) -> bool:
+    return any(
+        c.get("type") == ctype and c.get("status") == "True"
+        for c in obj.get("status", {}).get("conditions", [])
+    )
+
+
+def job_state(job: Obj) -> Optional[str]:
+    """'complete' | 'failed' | None (reference utils.go:23-49)."""
+    for c in job.get("status", {}).get("conditions", []):
+        if c.get("status") != "True":
+            continue
+        if c.get("type") in ("Complete", "Completed"):
+            return "complete"
+        if c.get("type") == "Failed":
+            return "failed"
+    return None
+
+
+def pod_ready(pod: Obj) -> bool:
+    """(reference utils.go:51-65)"""
+    if pod.get("status", {}).get("phase") != "Running":
+        return False
+    return any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in pod.get("status", {}).get("conditions", [])
+    )
+
+
+def reconcile_service_account(
+    client: KubeClient,
+    cloud: Cloud,
+    sci: SCIClient,
+    namespace: str,
+    name: str,
+) -> str:
+    """Ensure the workload SA exists, carries the cloud principal annotation,
+    and the principal<->SA identity binding has been made via SCI
+    (reference service_accounts_controller.go:38-66). Returns SA name."""
+    principal = cloud.associate_principal(namespace, name)
+    try:
+        sa = client.get("ServiceAccount", namespace, name)
+    except NotFound:
+        sa = client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": {"name": name, "namespace": namespace},
+            }
+        )
+    annotations = sa.setdefault("metadata", {}).setdefault("annotations", {})
+    if annotations.get(BOUND_ANNOTATION) != "true":
+        sci.bind_identity(principal, namespace, name)
+        annotations[PRINCIPAL_ANNOTATION] = principal
+        annotations[BOUND_ANNOTATION] = "true"
+        client.update(sa)
+    return name
+
+
+def reconcile_child(client: KubeClient, desired: Obj) -> Obj:
+    """Create the child if absent; return live state (reference
+    reconcileJob utils.go:23-35 — create-then-inspect, never mutate)."""
+    kind = desired["kind"]
+    md = desired["metadata"]
+    try:
+        return client.get(kind, md["namespace"], md["name"])
+    except NotFound:
+        return client.create(desired)
+
+
+def write_status(client: KubeClient, obj: Obj) -> Obj:
+    """Write obj's status only if it differs from the live object's status.
+
+    Idempotence is what lets the watch-driven queue quiesce: a reconcile
+    pass that changes nothing must write nothing (every write fans out a
+    MODIFIED event that re-enqueues the object)."""
+    md = obj["metadata"]
+    live = client.get(obj["kind"], md.get("namespace", "default"), md["name"])
+    if live.get("status") == obj.get("status"):
+        return live
+    live["status"] = obj.get("status")
+    return client.update_status(live)

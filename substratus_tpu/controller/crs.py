@@ -1,0 +1,440 @@
+"""Reconcilers for the four CR kinds (reference: internal/controller/
+{dataset,model,notebook,server}_controller.go).
+
+Behavior parity with the reference plus the TPU-first changes:
+  * workloads with multi-host TPU asks become JobSet+headless-Service gangs
+    (workloads.py) instead of single-pod Jobs;
+  * default images/commands point at the in-repo runtime entrypoints
+    (load.main / train.main / serve.main) instead of external
+    `substratusai/*` images (SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from substratus_tpu.api import conditions as C
+from substratus_tpu.cloud.base import Cloud
+from substratus_tpu.controller.common import (
+    SA_DATA_LOADER,
+    SA_MODELLER,
+    SA_MODEL_SERVER,
+    SA_NOTEBOOK,
+    condition_true,
+    job_state,
+    pod_ready,
+    reconcile_child,
+    reconcile_service_account,
+    set_condition,
+    write_status,
+)
+from substratus_tpu.controller.runtime import Result
+from substratus_tpu.controller.workloads import (
+    build_container,
+    build_pod,
+    owner_reference,
+    params_configmap,
+    workload_for_pod,
+)
+from substratus_tpu.kube.client import KubeClient, NotFound, Obj
+from substratus_tpu.sci.client import SCIClient
+
+# The one runtime image holding this package; commands select the entrypoint.
+DEFAULT_RUNTIME_IMAGE = "ghcr.io/substratus-tpu/runtime:latest"
+LOADER_COMMAND = ["python", "-m", "substratus_tpu.load.main"]
+TRAINER_COMMAND = ["python", "-m", "substratus_tpu.train.main"]
+SERVER_COMMAND = ["python", "-m", "substratus_tpu.serve.main"]
+NOTEBOOK_COMMAND = [
+    "jupyter", "lab", "--ip=0.0.0.0", "--port=8888", "--allow-root",
+    "--no-browser", "--notebook-dir=/content",
+]
+
+
+class _ObjRef:
+    def __init__(self, obj: Obj):
+        self.KIND = obj["kind"]
+        self.name = obj["metadata"]["name"]
+        self.namespace = obj["metadata"]["namespace"]
+
+
+class BaseReconciler:
+    def __init__(self, client: KubeClient, cloud: Cloud, sci: SCIClient):
+        self.client = client
+        self.cloud = cloud
+        self.sci = sci
+
+    # -- shared gates ------------------------------------------------------
+
+    def image_gate(self, obj: Obj) -> bool:
+        """True = proceed. A CR with a build in flight has no image yet
+        (reference model_controller.go:54-57)."""
+        spec = obj.get("spec") or {}
+        if spec.get("image"):
+            return True
+        if spec.get("build"):
+            return False  # BuildReconciler owns progress
+        # No image, no build: run the in-repo runtime image.
+        fresh = self.client.get(
+            obj["kind"], obj["metadata"]["namespace"], obj["metadata"]["name"]
+        )
+        fresh["spec"]["image"] = DEFAULT_RUNTIME_IMAGE
+        self.client.update(fresh)
+        obj["spec"]["image"] = DEFAULT_RUNTIME_IMAGE
+        return True
+
+    def stamp_artifacts_url(self, obj: Obj) -> str:
+        url = self.cloud.object_artifact_url(_ObjRef(obj))
+        status = obj.setdefault("status", {})
+        if (status.get("artifacts") or {}).get("url") != url:
+            status["artifacts"] = {"url": url}
+            write_status(self.client, obj)
+        return url
+
+    def artifact_url_of(self, dep: Obj) -> str:
+        return (dep.get("status", {}).get("artifacts") or {}).get(
+            "url"
+        ) or self.cloud.object_artifact_url(_ObjRef(dep))
+
+    def resolve_ref(
+        self,
+        obj: Obj,
+        field: str,
+        kind: str,
+        cond_type: str,
+        not_found_reason: str,
+        not_ready_reason: str,
+    ) -> Tuple[Optional[Obj], Optional[Result]]:
+        """Fetch a referenced CR; set a typed condition and park (watch
+        indexes requeue us) when missing/not ready (reference
+        model_controller.go:92-172)."""
+        ref = (obj.get("spec") or {}).get(field)
+        if not ref:
+            return None, None
+        ns = ref.get("namespace") or obj["metadata"]["namespace"]
+        try:
+            dep = self.client.get(kind, ns, ref["name"])
+        except NotFound:
+            set_condition(
+                obj, cond_type, False, not_found_reason,
+                f"{kind} {ns}/{ref['name']} not found",
+            )
+            write_status(self.client, obj)
+            return None, Result()
+        if not dep.get("status", {}).get("ready"):
+            set_condition(
+                obj, cond_type, False, not_ready_reason,
+                f"{kind} {ns}/{ref['name']} not ready",
+            )
+            write_status(self.client, obj)
+            return None, Result()
+        return dep, None
+
+    def finish_from_workload(
+        self, obj: Obj, workload: Obj, cond_type: str
+    ) -> None:
+        state = job_state(workload)
+        if state == "complete":
+            set_condition(obj, cond_type, True, C.REASON_JOB_COMPLETE)
+            obj["status"]["ready"] = True
+        elif state == "failed":
+            set_condition(obj, cond_type, False, C.REASON_JOB_FAILED)
+            obj["status"]["ready"] = False
+        else:
+            set_condition(obj, cond_type, False, C.REASON_JOB_NOT_COMPLETE)
+            obj["status"]["ready"] = False
+        write_status(self.client, obj)
+
+    def backoff_limit(self, obj: Obj) -> int:
+        """Accelerator jobs are expensive: don't blind-retry (reference
+        model_controller.go:294-303 — 0 for GPU jobs, 2 for cheap ones)."""
+        res = (obj.get("spec") or {}).get("resources") or {}
+        if res.get("tpu") or (res.get("gpu") or {}).get("count"):
+            return 0
+        return 2
+
+
+class DatasetReconciler(BaseReconciler):
+    """-data-loader Job with RW artifacts mount (reference
+    dataset_controller.go:35-217)."""
+
+    def __call__(self, obj: Obj) -> Result:
+        if obj.get("status", {}).get("ready") and condition_true(
+            obj, C.CONDITION_COMPLETE
+        ):
+            return Result()
+        if not self.image_gate(obj):
+            return Result()
+        reconcile_child(self.client, params_configmap(obj))
+        url = self.stamp_artifacts_url(obj)
+        reconcile_service_account(
+            self.client, self.cloud, self.sci,
+            obj["metadata"]["namespace"], SA_DATA_LOADER,
+        )
+        container = build_container(
+            obj, self.cloud, artifact_mounts={}, default_command=LOADER_COMMAND
+        )
+        pod = build_pod(
+            obj, self.cloud,
+            name=f"{obj['metadata']['name']}-data-loader",
+            sa_name=SA_DATA_LOADER,
+            container=container,
+            mounts={
+                "artifacts": (url, {"artifacts": "/content/artifacts"}, False)
+            },
+        )
+        workloads = workload_for_pod(obj, pod, self.backoff_limit(obj))
+        live = [reconcile_child(self.client, w) for w in workloads]
+        self.finish_from_workload(obj, live[-1], C.CONDITION_COMPLETE)
+        return Result()
+
+
+class ModelReconciler(BaseReconciler):
+    """-modeller Job/JobSet: import (no refs) or finetune (base model +
+    dataset RO mounts) (reference model_controller.go:43-218, 286-395)."""
+
+    def __call__(self, obj: Obj) -> Result:
+        if obj.get("status", {}).get("ready") and condition_true(
+            obj, C.CONDITION_COMPLETE
+        ):
+            return Result()
+        if not self.image_gate(obj):
+            return Result()
+        reconcile_child(self.client, params_configmap(obj))
+        url = self.stamp_artifacts_url(obj)
+        ns = obj["metadata"]["namespace"]
+        reconcile_service_account(
+            self.client, self.cloud, self.sci, ns, SA_MODELLER
+        )
+
+        base_model, park = self.resolve_ref(
+            obj, "model", "Model", C.CONDITION_COMPLETE,
+            C.REASON_MODEL_NOT_FOUND, C.REASON_MODEL_NOT_READY,
+        )
+        if park:
+            return park
+        dataset, park = self.resolve_ref(
+            obj, "dataset", "Dataset", C.CONDITION_COMPLETE,
+            C.REASON_DATASET_NOT_FOUND, C.REASON_DATASET_NOT_READY,
+        )
+        if park:
+            return park
+
+        mounts: Dict[str, tuple] = {
+            "artifacts": (url, {"artifacts": "/content/artifacts"}, False)
+        }
+        if base_model is not None:
+            mounts["model"] = (
+                self.artifact_url_of(base_model),
+                {"artifacts": "/content/model"},
+                True,
+            )
+        if dataset is not None:
+            mounts["data"] = (
+                self.artifact_url_of(dataset),
+                {"artifacts": "/content/data"},
+                True,
+            )
+
+        default_cmd = TRAINER_COMMAND if dataset is not None else LOADER_COMMAND
+        container = build_container(
+            obj, self.cloud, artifact_mounts={}, default_command=default_cmd
+        )
+        pod = build_pod(
+            obj, self.cloud,
+            name=f"{obj['metadata']['name']}-modeller",
+            sa_name=SA_MODELLER,
+            container=container,
+            mounts=mounts,
+        )
+        workloads = workload_for_pod(obj, pod, self.backoff_limit(obj))
+        live = [reconcile_child(self.client, w) for w in workloads]
+        self.finish_from_workload(obj, live[-1], C.CONDITION_COMPLETE)
+        return Result()
+
+
+class NotebookReconciler(BaseReconciler):
+    """Long-running -notebook Pod with jupyter; suspend deletes the Pod
+    (reference notebook_controller.go:131-155, 316-454)."""
+
+    def __call__(self, obj: Obj) -> Result:
+        md = obj["metadata"]
+        ns = md["namespace"]
+        pod_name = f"{md['name']}-notebook"
+        if (obj.get("spec") or {}).get("suspend"):
+            try:
+                self.client.delete("Pod", ns, pod_name)
+            except NotFound:
+                pass
+            obj.setdefault("status", {})["ready"] = False
+            set_condition(
+                obj, C.CONDITION_DEPLOYED, False, C.REASON_SUSPENDED
+            )
+            write_status(self.client, obj)
+            return Result()
+
+        if not self.image_gate(obj):
+            return Result()
+        reconcile_child(self.client, params_configmap(obj))
+        url = self.stamp_artifacts_url(obj)
+        reconcile_service_account(
+            self.client, self.cloud, self.sci, ns, SA_NOTEBOOK
+        )
+
+        base_model, park = self.resolve_ref(
+            obj, "model", "Model", C.CONDITION_DEPLOYED,
+            C.REASON_MODEL_NOT_FOUND, C.REASON_MODEL_NOT_READY,
+        )
+        if park:
+            return park
+        dataset, park = self.resolve_ref(
+            obj, "dataset", "Dataset", C.CONDITION_DEPLOYED,
+            C.REASON_DATASET_NOT_FOUND, C.REASON_DATASET_NOT_READY,
+        )
+        if park:
+            return park
+
+        mounts: Dict[str, tuple] = {
+            "artifacts": (url, {"artifacts": "/content/artifacts"}, False)
+        }
+        if base_model is not None:
+            mounts["model"] = (
+                self.artifact_url_of(base_model),
+                {"artifacts": "/content/model"}, True,
+            )
+        if dataset is not None:
+            mounts["data"] = (
+                self.artifact_url_of(dataset),
+                {"artifacts": "/content/data"}, True,
+            )
+
+        container = build_container(
+            obj, self.cloud, artifact_mounts={},
+            default_command=NOTEBOOK_COMMAND,
+            ports=[{"containerPort": 8888, "name": "notebook"}],
+        )
+        container["env"].append(
+            {"name": "NOTEBOOK_TOKEN", "value": "default"}
+        )
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/api", "port": 8888},
+            "initialDelaySeconds": 2,
+            "periodSeconds": 5,
+        }
+        pod = build_pod(
+            obj, self.cloud,
+            name=pod_name,
+            sa_name=SA_NOTEBOOK,
+            container=container,
+            mounts=mounts,
+            restart_policy="Always",
+        )
+        desired_pod: Obj = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "namespace": ns,
+                "ownerReferences": [owner_reference(obj)],
+                **pod["metadata"],
+            },
+            "spec": pod["spec"],
+        }
+        live = reconcile_child(self.client, desired_pod)
+        ready = pod_ready(live)
+        obj.setdefault("status", {})["ready"] = ready
+        set_condition(
+            obj, C.CONDITION_DEPLOYED, ready,
+            C.REASON_POD_READY if ready else C.REASON_POD_NOT_READY,
+        )
+        write_status(self.client, obj)
+        return Result()
+
+
+class ServerReconciler(BaseReconciler):
+    """-server Deployment + Service; Serving condition from readyReplicas
+    (reference server_controller.go:50-335)."""
+
+    def __call__(self, obj: Obj) -> Result:
+        if not self.image_gate(obj):
+            return Result()
+        reconcile_child(self.client, params_configmap(obj))
+        md = obj["metadata"]
+        ns = md["namespace"]
+
+        model, park = self.resolve_ref(
+            obj, "model", "Model", C.CONDITION_SERVING,
+            C.REASON_MODEL_NOT_FOUND, C.REASON_MODEL_NOT_READY,
+        )
+        if park:
+            return park
+        reconcile_service_account(
+            self.client, self.cloud, self.sci, ns, SA_MODEL_SERVER
+        )
+
+        mounts: Dict[str, tuple] = {}
+        if model is not None:
+            mounts["model"] = (
+                self.artifact_url_of(model),
+                {"artifacts": "/content/model"}, True,
+            )
+        container = build_container(
+            obj, self.cloud, artifact_mounts={},
+            default_command=SERVER_COMMAND,
+            ports=[{"containerPort": 8080, "name": "http-serve"}],
+        )
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/", "port": 8080},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10,
+        }
+        pod = build_pod(
+            obj, self.cloud,
+            name=f"{md['name']}-server",
+            sa_name=SA_MODEL_SERVER,
+            container=container,
+            mounts=mounts,
+            restart_policy="Always",
+        )
+        replicas = int((obj.get("spec") or {}).get("params", {}).get("replicas", 1))
+        deployment: Obj = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": f"{md['name']}-server",
+                "namespace": ns,
+                "ownerReferences": [owner_reference(obj)],
+            },
+            "spec": {
+                "replicas": replicas,
+                "selector": {
+                    "matchLabels": {
+                        "substratus.ai/object": f"server-{md['name']}"
+                    }
+                },
+                "template": {"metadata": pod["metadata"], "spec": pod["spec"]},
+            },
+        }
+        service: Obj = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"{md['name']}-server",
+                "namespace": ns,
+                "ownerReferences": [owner_reference(obj)],
+            },
+            "spec": {
+                "selector": {"substratus.ai/object": f"server-{md['name']}"},
+                "ports": [
+                    {"port": 8080, "targetPort": "http-serve", "name": "http"}
+                ],
+            },
+        }
+        reconcile_child(self.client, service)
+        live = reconcile_child(self.client, deployment)
+        ready = (live.get("status", {}).get("readyReplicas") or 0) > 0
+        obj.setdefault("status", {})["ready"] = ready
+        set_condition(
+            obj, C.CONDITION_SERVING, ready,
+            C.REASON_DEPLOYMENT_READY if ready else C.REASON_DEPLOYMENT_NOT_READY,
+        )
+        write_status(self.client, obj)
+        return Result()

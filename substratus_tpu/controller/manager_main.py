@@ -1,0 +1,86 @@
+"""Controller-manager wiring + entrypoint (reference:
+cmd/controllermanager/main.go:40-240).
+
+    python -m substratus_tpu.controller.manager_main [--fake] [--sci-address ...]
+
+Wires cloud autodetect, SCI client, and 4x(Build + main) reconcilers onto the
+Manager; serves healthz/readyz + Prometheus-format metrics on :8081.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional
+
+from substratus_tpu.cloud.base import Cloud, new_cloud
+from substratus_tpu.controller.build import BuildReconciler
+from substratus_tpu.controller.crs import (
+    DatasetReconciler,
+    ModelReconciler,
+    NotebookReconciler,
+    ServerReconciler,
+)
+from substratus_tpu.controller.runtime import Manager
+from substratus_tpu.kube.client import KubeClient
+from substratus_tpu.sci.client import FakeSCIClient, SCIClient
+
+
+def build_manager(
+    client: KubeClient, cloud: Cloud, sci: SCIClient
+) -> Manager:
+    mgr = Manager(client)
+    for kind, main_cls in (
+        ("Dataset", DatasetReconciler),
+        ("Model", ModelReconciler),
+        ("Notebook", NotebookReconciler),
+        ("Server", ServerReconciler),
+    ):
+        mgr.register(kind, BuildReconciler(client, cloud, sci))
+        mgr.register(kind, main_cls(client, cloud, sci))
+    return mgr
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--sci-address",
+        default=os.environ.get(
+            "SCI_ADDRESS", "sci.substratus.svc.cluster.local:10080"
+        ),
+    )
+    ap.add_argument("--cloud", default=None)
+    ap.add_argument("--probe-port", type=int, default=8081)
+    ap.add_argument(
+        "--fake", action="store_true",
+        help="in-memory apiserver + fake SCI (local development)",
+    )
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    cloud = new_cloud(args.cloud)
+    if args.fake:
+        from substratus_tpu.kube.fake import FakeKube
+
+        client: KubeClient = FakeKube()
+        sci: SCIClient = FakeSCIClient()
+    else:
+        from substratus_tpu.kube.real import RealKube
+        from substratus_tpu.sci.grpc_transport import GrpcSCIClient
+
+        client = RealKube.in_cluster()
+        sci = GrpcSCIClient(args.sci_address)
+
+    mgr = build_manager(client, cloud, sci)
+    mgr.bootstrap()
+    thread = mgr.start()
+
+    from substratus_tpu.observability.health import serve_health
+
+    serve_health(port=args.probe_port, manager=mgr)
+    thread.join()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,179 @@
+"""Controller runtime: watch-driven reconcile loop with dependency indexes.
+
+The reference leans on controller-runtime (manager, workqueue, field indexes
+— internal/controller/manager.go:14-72, cmd/controllermanager/main.go). This
+is the same model rebuilt small:
+
+  * every apiserver event enqueues the object's own reconciler (if any),
+    its owner CR (ownerReferences walk — how Job/Pod status changes wake the
+    CR that created them), and any CRs whose spec references the changed
+    object (the `spec.model.name` / `spec.dataset.name` indexes that drive
+    dependent wakeup, reference manager.go:23-72);
+  * a deduplicating FIFO workqueue; reconcilers are idempotent and read
+    fresh state every pass;
+  * `run_until_idle()` drains the queue synchronously — the deterministic
+    test mode (no Eventually-polling, unlike envtest) — while `start()` runs
+    the same loop on a thread for real deployments.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from substratus_tpu.kube.client import Conflict, KubeClient, NotFound, Obj
+
+log = logging.getLogger("substratus.controller")
+
+CR_KINDS = ("Dataset", "Model", "Notebook", "Server")
+
+
+@dataclass
+class Result:
+    requeue_after: Optional[float] = None  # seconds; None = wait for events
+
+
+Reconciler = Callable[[Obj], Result]
+
+
+class Manager:
+    def __init__(self, client: KubeClient):
+        self.client = client
+        self.reconcilers: Dict[str, List[Reconciler]] = {}
+        self._queue: deque = deque()
+        self._queued: set = set()
+        self._delayed: List[Tuple[float, tuple]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        client.add_listener(self._on_event)
+
+    def register(self, kind: str, reconciler: Reconciler) -> None:
+        self.reconcilers.setdefault(kind, []).append(reconciler)
+
+    # -- event routing -----------------------------------------------------
+
+    def enqueue(self, kind: str, namespace: str, name: str) -> None:
+        item = (kind, namespace, name)
+        with self._lock:
+            if item not in self._queued:
+                self._queued.add(item)
+                self._queue.append(item)
+        self._wake.set()
+
+    def _on_event(self, event: str, obj: Obj) -> None:
+        kind = obj.get("kind")
+        md = obj.get("metadata", {})
+        ns, name = md.get("namespace", "default"), md.get("name")
+
+        if kind in self.reconcilers:
+            self.enqueue(kind, ns, name)
+
+        # Owner wakeup: Job/Pod/Deployment/JobSet status changes requeue the
+        # CR that owns them.
+        for ref in md.get("ownerReferences", []):
+            if ref.get("kind") in self.reconcilers:
+                self.enqueue(ref["kind"], ns, ref["name"])
+
+        # Reference-index wakeup (reference manager.go:23-72): when a Model
+        # or Dataset changes, requeue CRs whose spec points at it.
+        if kind in ("Model", "Dataset"):
+            field = "model" if kind == "Model" else "dataset"
+            for dep_kind in ("Model", "Notebook", "Server"):
+                if dep_kind not in self.reconcilers:
+                    continue
+                for dep in self.client.list(dep_kind, ns):
+                    ref = (dep.get("spec") or {}).get(field) or {}
+                    if ref.get("name") == name and (
+                        ref.get("namespace") or ns
+                    ) == ns:
+                        dmd = dep["metadata"]
+                        self.enqueue(dep_kind, dmd["namespace"], dmd["name"])
+
+    # -- loop --------------------------------------------------------------
+
+    def _pop(self) -> Optional[tuple]:
+        with self._lock:
+            now = time.monotonic()
+            ready = [i for i, (t, _) in enumerate(self._delayed) if t <= now]
+            for i in reversed(ready):
+                _, item = self._delayed.pop(i)
+                if item not in self._queued:
+                    self._queued.add(item)
+                    self._queue.append(item)
+            if not self._queue:
+                return None
+            item = self._queue.popleft()
+            self._queued.discard(item)
+            return item
+
+    def _process(self, item: tuple) -> None:
+        kind, ns, name = item
+        try:
+            obj = self.client.get(kind, ns, name)
+        except NotFound:
+            return  # deleted; nothing to do (GC is ownerRef-driven)
+        for rec in self.reconcilers.get(kind, []):
+            try:
+                result = rec(obj)
+            except Conflict:
+                # Optimistic-concurrency race: someone wrote between our read
+                # and write. Requeue and re-read.
+                self.enqueue(kind, ns, name)
+                return
+            except NotFound:
+                return
+            except Exception:
+                log.exception("reconcile %s %s/%s failed", kind, ns, name)
+                with self._lock:
+                    self._delayed.append((time.monotonic() + 5.0, item))
+                return
+            if result and result.requeue_after is not None:
+                with self._lock:
+                    self._delayed.append(
+                        (time.monotonic() + result.requeue_after, item)
+                    )
+                return
+            # Re-read: a later reconciler in the chain must see the writes of
+            # an earlier one.
+            try:
+                obj = self.client.get(kind, ns, name)
+            except NotFound:
+                return
+
+    def run_until_idle(self, max_iterations: int = 10_000) -> None:
+        """Drain the queue synchronously (test/deterministic mode)."""
+        for _ in range(max_iterations):
+            item = self._pop()
+            if item is None:
+                return
+            self._process(item)
+        raise RuntimeError("reconcile queue did not quiesce")
+
+    def start(self) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                item = self._pop()
+                if item is None:
+                    self._wake.wait(timeout=0.2)
+                    self._wake.clear()
+                    continue
+                self._process(item)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def bootstrap(self) -> None:
+        """Enqueue every existing CR (controller restart catch-up)."""
+        for kind in self.reconcilers:
+            for obj in self.client.list(kind):
+                md = obj["metadata"]
+                self.enqueue(kind, md["namespace"], md["name"])

@@ -1,0 +1,286 @@
+"""Workload construction shared by the CR reconcilers.
+
+Builds the pod/Job/JobSet/Deployment dicts that run contract containers:
+/content/* mounts, params ConfigMap, PARAM_* env, secret-ref env resolution,
+owner references for GC + watch wakeup, and — the TPU-first part the
+reference never had (SURVEY.md §2.3) — multi-host TPU slice wiring: a JobSet
+of one Job per slice host with a headless Service for worker discovery and
+the TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / MEGASCALE coordinator env that
+`jax.distributed.initialize` consumes (parallel/distributed.py).
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+from substratus_tpu.api.types import API_VERSION
+from substratus_tpu.cloud.base import Cloud
+from substratus_tpu.kube.client import Obj
+from substratus_tpu.resources.apply import apply_resources
+from substratus_tpu.utils.serde import from_dict
+
+CONTENT_DIR = "/content"
+SECRET_REF_RE = re.compile(
+    r"^\s*\$\{\{\s*secrets\.([A-Za-z0-9-_.]+)\.([A-Za-z0-9-_.]+)\s*\}\}\s*$"
+)
+
+
+def owner_reference(obj: Obj) -> Dict[str, Any]:
+    md = obj["metadata"]
+    return {
+        "apiVersion": obj.get("apiVersion", API_VERSION),
+        "kind": obj["kind"],
+        "name": md["name"],
+        "uid": md.get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def resolve_env(env: Dict[str, str]) -> List[Dict[str, Any]]:
+    """CR env -> container env; `${{ secrets.name.key }}` values become
+    SecretKeyRef entries (reference utils.go:67-93)."""
+    out: List[Dict[str, Any]] = []
+    for key, value in sorted((env or {}).items()):
+        m = SECRET_REF_RE.match(str(value))
+        if m:
+            out.append(
+                {
+                    "name": key,
+                    "valueFrom": {
+                        "secretKeyRef": {"name": m.group(1), "key": m.group(2)}
+                    },
+                }
+            )
+        else:
+            out.append({"name": key, "value": str(value)})
+    return out
+
+
+def params_env(params: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """params {k: v} -> PARAM_K env vars (docs/design.md:271-281)."""
+    out = []
+    for key, value in sorted((params or {}).items()):
+        name = "PARAM_" + re.sub(r"[^A-Za-z0-9]", "_", str(key)).upper()
+        if isinstance(value, (dict, list)):
+            value = json.dumps(value)
+        out.append({"name": name, "value": str(value)})
+    return out
+
+
+def params_configmap(obj: Obj) -> Obj:
+    """ConfigMap `{name}-{kind}-params` holding params.json (reference
+    params_reconciler.go:28-104)."""
+    md = obj["metadata"]
+    params = (obj.get("spec") or {}).get("params") or {}
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": f"{md['name']}-{obj['kind'].lower()}-params",
+            "namespace": md["namespace"],
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "data": {"params.json": json.dumps(params, sort_keys=True)},
+    }
+
+
+def build_container(
+    obj: Obj,
+    cloud: Cloud,
+    *,
+    artifact_mounts: Dict[str, tuple],  # volume name -> (bucket_url, subpath->target, ro)
+    default_command: Optional[List[str]] = None,
+    ports: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """The single workload container + its pod-level mount side effects are
+    assembled by build_pod_spec; this returns the container skeleton."""
+    spec = obj.get("spec") or {}
+    container: Dict[str, Any] = {
+        "name": obj["kind"].lower(),
+        "image": spec.get("image"),
+        "workingDir": CONTENT_DIR,
+        "env": resolve_env(spec.get("env"))
+        + params_env(spec.get("params")),
+    }
+    if spec.get("command"):
+        container["command"] = list(spec["command"])
+    elif default_command:
+        container["command"] = list(default_command)
+    if ports:
+        container["ports"] = ports
+    return container
+
+
+def build_pod(
+    obj: Obj,
+    cloud: Cloud,
+    *,
+    name: str,
+    sa_name: str,
+    container: Dict[str, Any],
+    mounts: Dict[str, tuple],  # volname -> (bucket_url, {sub: target}, read_only)
+    restart_policy: str = "Never",
+) -> Dict[str, Any]:
+    """Pod template dict with params CM mount + bucket mounts + resources."""
+    md = obj["metadata"]
+    spec = obj.get("spec") or {}
+    pod_metadata: Dict[str, Any] = {
+        "labels": {
+            "app.kubernetes.io/managed-by": "substratus-tpu",
+            "substratus.ai/object": f"{obj['kind'].lower()}-{md['name']}",
+        },
+        "annotations": {"kubectl.kubernetes.io/default-container": container["name"]},
+    }
+    pod_spec: Dict[str, Any] = {
+        "serviceAccountName": sa_name,
+        "restartPolicy": restart_policy,
+        "containers": [container],
+    }
+
+    # params.json mount via subPath (reference params_reconciler.go:78-104).
+    cm_name = f"{md['name']}-{obj['kind'].lower()}-params"
+    pod_spec.setdefault("volumes", []).append(
+        {"name": "params", "configMap": {"name": cm_name}}
+    )
+    container.setdefault("volumeMounts", []).append(
+        {
+            "name": "params",
+            "mountPath": f"{CONTENT_DIR}/params.json",
+            "subPath": "params.json",
+        }
+    )
+
+    for vol_name, (bucket_url, sub_mounts, read_only) in mounts.items():
+        cloud.mount_bucket(
+            pod_metadata, pod_spec, container, vol_name, bucket_url,
+            sub_mounts, read_only=read_only,
+        )
+
+    from substratus_tpu.api.common import Resources
+
+    res = from_dict(Resources, spec.get("resources"))
+    slice_info = apply_resources(
+        pod_metadata, pod_spec, container, cloud.name, res
+    )
+    return {
+        "metadata": pod_metadata,
+        "spec": pod_spec,
+        "_slice": slice_info,
+        "_name": name,
+    }
+
+
+def job_from_pod(obj: Obj, pod: Dict[str, Any], backoff_limit: int) -> Obj:
+    md = obj["metadata"]
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": pod["_name"],
+            "namespace": md["namespace"],
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            "backoffLimit": backoff_limit,
+            "template": {"metadata": pod["metadata"], "spec": pod["spec"]},
+        },
+    }
+
+
+def _coordinator_fqdn(jobset_name: str, namespace: str) -> str:
+    # JobSet pod DNS: {jobset}-{replicatedJob}-{jobIndex}-{podIndex}.{jobset}
+    return f"{jobset_name}-workers-0-0.{jobset_name}.{namespace}"
+
+
+def jobset_from_pod(
+    obj: Obj, pod: Dict[str, Any], backoff_limit: int = 0
+) -> List[Obj]:
+    """Multi-host TPU slice: JobSet (one replicated Job, num_hosts indexed
+    pods) + headless Service for stable worker DNS. Greenfield vs the
+    reference (its Jobs were single-pod, SURVEY.md §2.3)."""
+    md = obj["metadata"]
+    slice_info = pod["_slice"]
+    n = slice_info["num_hosts"]
+    name = pod["_name"]
+    coord = _coordinator_fqdn(name, md["namespace"])
+    hostnames = ",".join(
+        f"{name}-workers-0-{i}.{name}.{md['namespace']}" for i in range(n)
+    )
+    container = pod["spec"]["containers"][0]
+    container.setdefault("env", []).extend(
+        [
+            {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
+            {
+                "name": "TPU_WORKER_ID",
+                "valueFrom": {
+                    "fieldRef": {
+                        "fieldPath": (
+                            "metadata.annotations"
+                            "['batch.kubernetes.io/job-completion-index']"
+                        )
+                    }
+                },
+            },
+            {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value": coord},
+            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{coord}:8476"},
+            {"name": "JAX_NUM_PROCESSES", "value": str(n)},
+        ]
+    )
+    pod["spec"]["subdomain"] = name
+    pod["spec"]["hostNetwork"] = False
+
+    headless_svc: Obj = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "namespace": md["namespace"],
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"jobset.sigs.k8s.io/jobset-name": name},
+        },
+    }
+    jobset: Obj = {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {
+            "name": name,
+            "namespace": md["namespace"],
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            # all-or-nothing: any host failure recreates the whole slice
+            # group; checkpoint-resume picks up from the last save.
+            "failurePolicy": {"maxRestarts": 3},
+            "replicatedJobs": [
+                {
+                    "name": "workers",
+                    "replicas": 1,
+                    "template": {
+                        "spec": {
+                            "backoffLimit": backoff_limit,
+                            "completions": n,
+                            "parallelism": n,
+                            "completionMode": "Indexed",
+                            "template": {
+                                "metadata": pod["metadata"],
+                                "spec": pod["spec"],
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+    return [headless_svc, jobset]
+
+
+def workload_for_pod(obj: Obj, pod: Dict[str, Any], backoff_limit: int) -> List[Obj]:
+    """Single-host -> [Job]; multi-host TPU -> [Service, JobSet]."""
+    if pod["_slice"]["num_hosts"] > 1:
+        return jobset_from_pod(obj, pod, backoff_limit)
+    return [job_from_pod(obj, pod, backoff_limit)]

@@ -1,0 +1,4 @@
+from substratus_tpu.kube.client import KubeClient, NotFound, Conflict
+from substratus_tpu.kube.fake import FakeKube
+
+__all__ = ["KubeClient", "FakeKube", "NotFound", "Conflict"]

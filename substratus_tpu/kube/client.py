@@ -1,0 +1,80 @@
+"""Kubernetes client interface used by controllers, CLI and tests.
+
+The reference uses controller-runtime's cached client + dynamic REST mapper
+(internal/client/client.go:68-112). Here the surface is a small abstract
+API over plain-dict objects (apiVersion/kind/metadata/spec/status), with two
+implementations: kube.fake.FakeKube (in-memory apiserver for tests and local
+dev — the envtest equivalent) and kube.real.RealKube (REST against an actual
+apiserver)."""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, List, Optional
+
+Obj = Dict[str, Any]
+
+
+class KubeError(Exception):
+    pass
+
+
+class NotFound(KubeError):
+    pass
+
+
+class Conflict(KubeError):
+    pass
+
+
+def obj_key(obj: Obj) -> tuple:
+    md = obj.get("metadata", {})
+    return (obj.get("kind"), md.get("namespace", "default"), md.get("name"))
+
+
+class KubeClient(ABC):
+    @abstractmethod
+    def get(self, kind: str, namespace: str, name: str) -> Obj: ...
+
+    @abstractmethod
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[Obj]: ...
+
+    @abstractmethod
+    def create(self, obj: Obj) -> Obj: ...
+
+    @abstractmethod
+    def update(self, obj: Obj) -> Obj:
+        """Replace spec/metadata (optimistic concurrency via resourceVersion)."""
+
+    @abstractmethod
+    def update_status(self, obj: Obj) -> Obj: ...
+
+    @abstractmethod
+    def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+    @abstractmethod
+    def add_listener(self, fn: Callable[[str, Obj], None]) -> None:
+        """fn(event_type, obj) for every add/update/delete."""
+
+    # -- convenience -------------------------------------------------------
+
+    def get_or_none(self, kind: str, namespace: str, name: str) -> Optional[Obj]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def apply(self, obj: Obj) -> Obj:
+        """Server-side-apply-ish upsert: create, or merge spec/metadata onto
+        the existing object (reference client/upload.go:110-124 uses SSA)."""
+        kind, ns, name = obj_key(obj)
+        existing = self.get_or_none(kind, ns, name)
+        if existing is None:
+            return self.create(obj)
+        merged = dict(existing)
+        merged["spec"] = obj.get("spec", existing.get("spec"))
+        md = dict(existing.get("metadata", {}))
+        for k in ("labels", "annotations"):
+            if obj.get("metadata", {}).get(k):
+                md.setdefault(k, {}).update(obj["metadata"][k])
+        merged["metadata"] = md
+        return self.update(merged)

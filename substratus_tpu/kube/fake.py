@@ -1,0 +1,158 @@
+"""In-memory fake apiserver — the envtest tier of the test strategy.
+
+The reference's integration backbone is envtest: a real apiserver+etcd with
+faked Job/Pod status because no kubelet runs (SURVEY.md §4 tier 2,
+internal/controller/main_test.go:245-265). This fake goes one step lighter
+(pure in-memory store + synchronous listener fanout) which buys the tests
+something envtest can't: deterministic, poll-free assertions — after
+`manager.run_until_idle()` every reconcile consequence is visible.
+
+Data-plane faking helpers mirror the reference's: `mark_job_complete`,
+`mark_pod_ready`, `mark_deployment_ready`, `mark_jobset_complete`.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from substratus_tpu.kube.client import Conflict, KubeClient, NotFound, Obj
+
+
+class FakeKube(KubeClient):
+    def __init__(self):
+        self._store: Dict[tuple, Obj] = {}
+        self._rv = 0
+        self._uid = 0
+        self._listeners: List[Callable[[str, Obj], None]] = []
+        self._lock = threading.RLock()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _key(self, kind: str, namespace: str, name: str) -> tuple:
+        return (kind, namespace or "default", name)
+
+    def _bump(self, obj: Obj) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+
+    def _notify(self, event: str, obj: Obj) -> None:
+        for fn in list(self._listeners):
+            fn(event, copy.deepcopy(obj))
+
+    # -- KubeClient --------------------------------------------------------
+
+    def get(self, kind: str, namespace: str, name: str) -> Obj:
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            if key not in self._store:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._store[key])
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[Obj]:
+        with self._lock:
+            return [
+                copy.deepcopy(o)
+                for (k, ns, _), o in sorted(self._store.items())
+                if k == kind and (namespace is None or ns == namespace)
+            ]
+
+    def create(self, obj: Obj) -> Obj:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            md = obj.setdefault("metadata", {})
+            md.setdefault("namespace", "default")
+            key = self._key(obj["kind"], md["namespace"], md["name"])
+            if key in self._store:
+                raise Conflict(f"{key} already exists")
+            self._uid += 1
+            md.setdefault("uid", f"uid-{self._uid}")
+            md.setdefault("generation", 1)
+            self._bump(obj)
+            self._store[key] = obj
+            out = copy.deepcopy(obj)
+        self._notify("ADDED", out)
+        return out
+
+    def _update(self, obj: Obj, status_only: bool) -> Obj:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            md = obj.setdefault("metadata", {})
+            key = self._key(obj["kind"], md.get("namespace", "default"), md["name"])
+            if key not in self._store:
+                raise NotFound(f"{key} not found")
+            current = self._store[key]
+            sent_rv = md.get("resourceVersion")
+            cur_rv = current["metadata"].get("resourceVersion")
+            if sent_rv is not None and sent_rv != cur_rv:
+                raise Conflict(f"{key}: resourceVersion {sent_rv} != {cur_rv}")
+            new = copy.deepcopy(current)
+            if status_only:
+                new["status"] = copy.deepcopy(obj.get("status", {}))
+            else:
+                if obj.get("spec") != current.get("spec"):
+                    new["metadata"]["generation"] = (
+                        current["metadata"].get("generation", 1) + 1
+                    )
+                new["spec"] = copy.deepcopy(obj.get("spec"))
+                for k in ("labels", "annotations", "ownerReferences"):
+                    if k in md:
+                        new["metadata"][k] = copy.deepcopy(md[k])
+            self._bump(new)
+            self._store[key] = new
+            out = copy.deepcopy(new)
+        self._notify("MODIFIED", out)
+        return out
+
+    def update(self, obj: Obj) -> Obj:
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Obj) -> Obj:
+        return self._update(obj, status_only=True)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            if key not in self._store:
+                raise NotFound(f"{key} not found")
+            obj = self._store.pop(key)
+        self._notify("DELETED", obj)
+
+    def add_listener(self, fn: Callable[[str, Obj], None]) -> None:
+        self._listeners.append(fn)
+
+    # -- data-plane fakes (reference main_test.go:245-265) -----------------
+
+    def mark_job_complete(self, namespace: str, name: str, failed: bool = False):
+        job = self.get("Job", namespace, name)
+        if failed:
+            job["status"] = {
+                "conditions": [{"type": "Failed", "status": "True"}],
+                "failed": 1,
+            }
+        else:
+            job["status"] = {
+                "conditions": [{"type": "Complete", "status": "True"}],
+                "succeeded": 1,
+            }
+        self.update_status(job)
+
+    def mark_jobset_complete(self, namespace: str, name: str, failed: bool = False):
+        js = self.get("JobSet", namespace, name)
+        ctype = "Failed" if failed else "Completed"
+        js["status"] = {"conditions": [{"type": ctype, "status": "True"}]}
+        self.update_status(js)
+
+    def mark_pod_ready(self, namespace: str, name: str):
+        pod = self.get("Pod", namespace, name)
+        pod["status"] = {
+            "phase": "Running",
+            "conditions": [{"type": "Ready", "status": "True"}],
+        }
+        self.update_status(pod)
+
+    def mark_deployment_ready(self, namespace: str, name: str):
+        dep = self.get("Deployment", namespace, name)
+        replicas = dep.get("spec", {}).get("replicas", 1)
+        dep["status"] = {"readyReplicas": replicas, "replicas": replicas}
+        self.update_status(dep)

@@ -1,0 +1,194 @@
+"""REST client against a real Kubernetes apiserver.
+
+The reference uses client-go/controller-runtime (internal/client/client.go).
+This implementation speaks the same REST surface with stdlib HTTP: CRUD on
+the substratus.ai CRs and the core/batch/apps/jobset resources the
+controllers create, plus watch streams feeding Manager listeners. In-cluster
+config comes from the standard serviceaccount token mount; out-of-cluster
+from $KUBECONFIG (token/insecure-skip-tls only — exec plugins are out of
+scope for round 1).
+"""
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from substratus_tpu.api.types import GROUP, VERSION
+from substratus_tpu.kube.client import (
+    Conflict,
+    KubeClient,
+    KubeError,
+    NotFound,
+    Obj,
+)
+
+# kind -> (api prefix, plural)
+RESOURCE_MAP: Dict[str, tuple] = {
+    "Dataset": (f"/apis/{GROUP}/{VERSION}", "datasets"),
+    "Model": (f"/apis/{GROUP}/{VERSION}", "models"),
+    "Notebook": (f"/apis/{GROUP}/{VERSION}", "notebooks"),
+    "Server": (f"/apis/{GROUP}/{VERSION}", "servers"),
+    "Pod": ("/api/v1", "pods"),
+    "Service": ("/api/v1", "services"),
+    "ConfigMap": ("/api/v1", "configmaps"),
+    "Secret": ("/api/v1", "secrets"),
+    "ServiceAccount": ("/api/v1", "serviceaccounts"),
+    "Job": ("/apis/batch/v1", "jobs"),
+    "Deployment": ("/apis/apps/v1", "deployments"),
+    "JobSet": ("/apis/jobset.x-k8s.io/v1alpha2", "jobsets"),
+}
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class RealKube(KubeClient):
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        verify: bool = True,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self._listeners: List[Callable[[str, Obj], None]] = []
+        if ca_file:
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        elif not verify:
+            self._ctx = ssl._create_unverified_context()
+        else:
+            self._ctx = ssl.create_default_context()
+        self._watch_threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    @classmethod
+    def in_cluster(cls) -> "RealKube":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(f"{SA_DIR}/token") as f:
+            token = f.read().strip()
+        return cls(
+            f"https://{host}:{port}", token=token, ca_file=f"{SA_DIR}/ca.crt"
+        )
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _path(self, kind: str, namespace: Optional[str], name: Optional[str] = None,
+              subresource: Optional[str] = None) -> str:
+        prefix, plural = RESOURCE_MAP[kind]
+        p = prefix
+        if namespace:
+            p += f"/namespaces/{namespace}"
+        p += f"/{plural}"
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        return p
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 query: str = "") -> Any:
+        url = self.base_url + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise NotFound(path)
+            if e.code == 409:
+                raise Conflict(path)
+            raise KubeError(f"{method} {path}: {e.code} {e.read()[:500]!r}")
+        return json.loads(payload) if payload else None
+
+    # -- KubeClient --------------------------------------------------------
+
+    def get(self, kind: str, namespace: str, name: str) -> Obj:
+        return self._request("GET", self._path(kind, namespace, name))
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[Obj]:
+        out = self._request("GET", self._path(kind, namespace))
+        items = out.get("items", [])
+        for it in items:  # list items omit kind/apiVersion
+            it.setdefault("kind", kind)
+        return items
+
+    def create(self, obj: Obj) -> Obj:
+        md = obj["metadata"]
+        return self._request(
+            "POST", self._path(obj["kind"], md.get("namespace", "default")), obj
+        )
+
+    def update(self, obj: Obj) -> Obj:
+        md = obj["metadata"]
+        return self._request(
+            "PUT",
+            self._path(obj["kind"], md.get("namespace", "default"), md["name"]),
+            obj,
+        )
+
+    def update_status(self, obj: Obj) -> Obj:
+        md = obj["metadata"]
+        return self._request(
+            "PUT",
+            self._path(
+                obj["kind"], md.get("namespace", "default"), md["name"], "status"
+            ),
+            obj,
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._path(kind, namespace, name))
+
+    def add_listener(self, fn: Callable[[str, Obj], None]) -> None:
+        start_watches = not self._listeners
+        self._listeners.append(fn)
+        if start_watches:
+            for kind in RESOURCE_MAP:
+                t = threading.Thread(
+                    target=self._watch_loop, args=(kind,), daemon=True
+                )
+                t.start()
+                self._watch_threads.append(t)
+
+    # -- watch -------------------------------------------------------------
+
+    def _watch_loop(self, kind: str) -> None:
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                query = "watch=true" + (f"&resourceVersion={rv}" if rv else "")
+                url = self.base_url + self._path(kind, None) + "?" + query
+                req = urllib.request.Request(url)
+                req.add_header("Accept", "application/json")
+                if self.token:
+                    req.add_header("Authorization", f"Bearer {self.token}")
+                with urllib.request.urlopen(
+                    req, context=self._ctx, timeout=330
+                ) as r:
+                    for line in r:
+                        if self._stop.is_set():
+                            return
+                        event = json.loads(line)
+                        obj = event.get("object", {})
+                        obj.setdefault("kind", kind)
+                        rv = obj.get("metadata", {}).get("resourceVersion", rv)
+                        for fn in self._listeners:
+                            fn(event.get("type", "MODIFIED"), obj)
+            except Exception:
+                # watch dropped (timeout, apiserver restart): resume.
+                self._stop.wait(2.0)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,53 @@
+"""Dataset-loader container entrypoint (container contract).
+
+In-repo replacement for `substratusai/dataset-loader-http` (SURVEY.md §2.2;
+examples/datasets/*.yaml): fetches source files into /content/artifacts,
+where a Model finetune later mounts them RO at /content/data.
+
+    python -m substratus_tpu.load.dataset [--out /content/artifacts]
+
+params.json keys: urls (list of http(s) sources), files (list of local
+paths to copy — useful with pre-mounted volumes and in tests).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import urllib.request
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/content/artifacts")
+    ap.add_argument("--params", default="/content/params.json")
+    args = ap.parse_args(argv)
+
+    p = {}
+    if os.path.exists(args.params):
+        with open(args.params) as f:
+            p = json.load(f)
+    os.makedirs(args.out, exist_ok=True)
+
+    n = 0
+    for url in p.get("urls", []):
+        dest = os.path.join(args.out, os.path.basename(url.split("?")[0]))
+        print(f"fetching {url} -> {dest}", flush=True)
+        with urllib.request.urlopen(url, timeout=300) as r, open(
+            dest, "wb"
+        ) as f:
+            shutil.copyfileobj(r, f)
+        n += 1
+    for path in p.get("files", []):
+        dest = os.path.join(args.out, os.path.basename(path))
+        shutil.copy(path, dest)
+        n += 1
+    if n == 0:
+        print("warning: no sources given (params.urls / params.files empty)")
+    print(f"dataset artifact written: {n} files in {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+"""Model-loader container entrypoint (container contract).
+
+In-repo TPU-native replacement for `substratusai/model-loader-huggingface`
+(SURVEY.md §2.2; examples/llama2-7b/base-model.yaml:7): imports a HuggingFace
+checkpoint and writes a servable substratus artifact (Orbax params + config
+sidecar + tokenizer files) to /content/artifacts.
+
+    python -m substratus_tpu.load.main [--out /content/artifacts]
+
+params.json keys: name (HF repo id or local path), config (named config for
+weightless smoke imports), quantize (int8 stores quantized weights).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+
+import jax
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/content/artifacts")
+    ap.add_argument("--params", default="/content/params.json")
+    ap.add_argument("--name", default=None)
+    args = ap.parse_args(argv)
+
+    p = {}
+    if os.path.exists(args.params):
+        with open(args.params) as f:
+            p = json.load(f)
+    name = args.name or p.get("name")
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.train.checkpoints import save_artifact
+
+    if name:
+        from substratus_tpu.load.hf import load_pretrained
+
+        cfg, params = load_pretrained(name)
+        meta = {"source": name}
+    else:
+        # Weightless smoke import (reference parallel: opt-125m CPU smoke).
+        cfg_name = p.get("config", "tiny")
+        cfg = llama.CONFIGS[cfg_name]
+        params = llama.init_params(cfg, jax.random.key(int(p.get("seed", 0))))
+        meta = {"source": f"random:{cfg_name}"}
+
+    if p.get("quantize") == "int8":
+        from substratus_tpu.ops.quant import quantize_params
+
+        params = jax.jit(
+            lambda x: quantize_params(x, llama.quant_contracting(cfg))
+        )(params)
+        meta["quantize"] = "int8"
+
+    save_artifact(args.out, params, cfg, extra_meta=meta)
+
+    # Ship tokenizer artifacts alongside the weights so serving needs no
+    # network access.
+    if name and os.path.isdir(name):
+        for fname in (
+            "tokenizer.json", "tokenizer.model", "tokenizer_config.json",
+            "special_tokens_map.json",
+        ):
+            src = os.path.join(name, fname)
+            if os.path.exists(src):
+                shutil.copy(src, os.path.join(args.out, fname))
+    print(f"model artifact written to {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,77 @@
+"""healthz/readyz probes + Prometheus-format metrics.
+
+Reference parity: controller-runtime serves /healthz,/readyz (main.go:227-234)
+and Prometheus metrics behind kube-rbac-proxy (SURVEY.md §5). Here a single
+stdlib HTTP endpoint serves both; metrics are text-format counters the
+Manager updates (reconcile totals/errors/queue depth) — scrape-compatible
+without a client library.
+"""
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Optional
+
+
+class Metrics:
+    """Process-global counters, exposed in Prometheus text format."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+
+    def inc(self, name: str, labels: str = "", by: float = 1.0) -> None:
+        with self._lock:
+            key = (name, labels)
+            self.counters[key] = self.counters.get(key, 0.0) + by
+
+    def set(self, name: str, value: float, labels: str = "") -> None:
+        with self._lock:
+            self.counters[(name, labels)] = value
+
+    def render(self) -> str:
+        with self._lock:
+            lines = []
+            for (name, labels), value in sorted(self.counters.items()):
+                lines.append(
+                    f"{name}{{{labels}}} {value}" if labels else f"{name} {value}"
+                )
+            return "\n".join(lines) + "\n"
+
+
+METRICS = Metrics()
+
+
+def serve_health(
+    port: int = 8081, manager=None, block: bool = False
+) -> http.server.ThreadingHTTPServer:
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                body = b"ok"
+                self.send_response(200)
+            elif self.path == "/metrics":
+                if manager is not None:
+                    with manager._lock:
+                        METRICS.set(
+                            "substratus_workqueue_depth", len(manager._queue)
+                        )
+                body = METRICS.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+            else:
+                body = b"not found"
+                self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    if block:
+        server.serve_forever()
+    else:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

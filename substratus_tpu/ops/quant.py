@@ -61,6 +61,17 @@ def materialize(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
     return w
 
 
+def is_quantized(params: Any) -> bool:
+    """True if any leaf of the tree is already a QTensor."""
+    found = []
+    jax.tree.map(
+        lambda x: found.append(True) if isinstance(x, QTensor) else None,
+        params,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+    return bool(found)
+
+
 def quantize_params(params: Any, contracting_of: Any) -> Any:
     """Quantize every leaf with a non-empty entry in `contracting_of` (a
     pytree matching `params` whose leaves are contracting-dim tuples; the

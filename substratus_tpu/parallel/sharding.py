@@ -108,17 +108,20 @@ def logical_sharding(
     )
 
 
-def shard_tree(
+def sharding_tree(
     tree: Any,
     mesh: Mesh,
     logical_tree: Any,
     rules: LogicalRules = DEFAULT_RULES,
 ) -> Any:
-    """Device-put a pytree according to its logical annotations.
+    """Same-structure tree of NamedShardings for `tree`.
 
     Handles int8 QTensor leaves (ops/quant.py): the quantized values take the
     weight's sharding; the per-channel scale takes the same spec with size-1
-    (contracting, keepdims) dims left unsharded.
+    (contracting, keepdims) dims left unsharded. The returned tree carries a
+    QTensor *of shardings* at those positions so it flattens in lockstep with
+    the value tree (usable with device_put, jit shardings, or
+    ShapeDtypeStruct pairing).
     """
     from substratus_tpu.ops.quant import QTensor
 
@@ -133,10 +136,10 @@ def shard_tree(
                 ]
             )
             return QTensor(
-                q=jax.device_put(leaf.q, NamedSharding(mesh, P(*qspec))),
-                scale=jax.device_put(leaf.scale, NamedSharding(mesh, sspec)),
+                q=NamedSharding(mesh, P(*qspec)),
+                scale=NamedSharding(mesh, sspec),
             )
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return NamedSharding(mesh, spec)
 
     return jax.tree.map(
         one,
@@ -144,3 +147,15 @@ def shard_tree(
         logical_tree,
         is_leaf=lambda x: isinstance(x, QTensor),
     )
+
+
+def shard_tree(
+    tree: Any,
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: LogicalRules = DEFAULT_RULES,
+) -> Any:
+    """Device-put a pytree according to its logical annotations (QTensor
+    aware, see sharding_tree)."""
+    shardings = sharding_tree(tree, mesh, logical_tree, rules)
+    return jax.tree.map(jax.device_put, tree, shardings)

@@ -1,0 +1,8 @@
+from substratus_tpu.resources.accelerators import (
+    TPUInfo,
+    tpu_info,
+    validate_tpu,
+)
+from substratus_tpu.resources.apply import apply_resources
+
+__all__ = ["TPUInfo", "tpu_info", "validate_tpu", "apply_resources"]

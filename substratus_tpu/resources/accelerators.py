@@ -1,0 +1,93 @@
+"""TPU accelerator catalog: generations, topologies, GKE labels.
+
+TPU-first replacement for the reference's GPU table (gpu_info.go:14-48 maps
+a100/t4/l4 -> GKE accelerator nodeSelectors). TPUs add two notions GPUs don't
+have: a *topology* (the physical slice shape, e.g. 4x4) and *multi-host*
+slices (chips beyond one VM => a JobSet of pods that must gang-schedule).
+
+Sources for the constants: public GKE TPU docs (machine shapes and
+`cloud.google.com/gke-tpu-accelerator` / `gke-tpu-topology` labels).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TPUInfo:
+    generation: str  # "v4" | "v5e" | "v5p" | "v6e"
+    gke_accelerator: str  # nodeSelector value
+    chips_per_host: int  # chips on one VM (one pod per host)
+    hbm_gb_per_chip: int
+    # topology name -> total chips
+    topologies: Dict[str, int]
+
+
+CATALOG: Dict[str, TPUInfo] = {
+    "v4": TPUInfo(
+        "v4", "tpu-v4-podslice", 4, 32,
+        {"2x2x1": 4, "2x2x2": 8, "2x2x4": 16, "2x4x4": 32, "4x4x4": 64, "4x4x8": 128},
+    ),
+    "v5e": TPUInfo(
+        "v5e", "tpu-v5-lite-podslice", 4, 16,
+        {"1x1": 1, "2x2": 4, "2x4": 8, "4x4": 16, "4x8": 32, "8x8": 64, "8x16": 128, "16x16": 256},
+    ),
+    "v5p": TPUInfo(
+        "v5p", "tpu-v5p-slice", 4, 95,
+        {"2x2x1": 4, "2x2x2": 8, "2x2x4": 16, "2x4x4": 32, "4x4x4": 64, "4x4x8": 128},
+    ),
+    "v6e": TPUInfo(
+        "v6e", "tpu-v6e-slice", 4, 32,
+        {"1x1": 1, "2x2": 4, "2x4": 8, "4x4": 16, "4x8": 32, "8x8": 64, "8x16": 128, "16x16": 256},
+    ),
+}
+
+
+def tpu_info(generation: str) -> TPUInfo:
+    gen = generation.lower()
+    if gen not in CATALOG:
+        raise ValueError(
+            f"unknown TPU type {generation!r}; known: {sorted(CATALOG)}"
+        )
+    return CATALOG[gen]
+
+
+def derive_topology(generation: str, chips: int) -> str:
+    """Smallest catalog topology with >= chips (exact match preferred)."""
+    info = tpu_info(generation)
+    exact = [t for t, c in info.topologies.items() if c == chips]
+    if exact:
+        return exact[0]
+    bigger = sorted(
+        ((c, t) for t, c in info.topologies.items() if c > chips)
+    )
+    if not bigger:
+        raise ValueError(
+            f"no {generation} topology holds {chips} chips "
+            f"(max {max(info.topologies.values())})"
+        )
+    return bigger[0][1]
+
+
+def validate_tpu(generation: str, chips: int, topology: Optional[str]) -> Tuple[str, int, int]:
+    """Returns (topology, num_hosts, chips_per_host_pod).
+
+    num_hosts > 1 means a multi-host slice: the workload must run as a JobSet
+    of num_hosts pods, each requesting chips_per_host_pod `google.com/tpu`.
+    """
+    info = tpu_info(generation)
+    topo = topology or derive_topology(generation, chips)
+    if topo not in info.topologies:
+        raise ValueError(
+            f"unknown topology {topo!r} for {generation}; known: "
+            f"{sorted(info.topologies)}"
+        )
+    total = info.topologies[topo]
+    if chips > total:
+        raise ValueError(f"topology {topo} holds {total} chips < requested {chips}")
+    if total <= info.chips_per_host:
+        return topo, 1, total
+    if total % info.chips_per_host:
+        raise ValueError(f"topology {topo} not divisible into hosts")
+    return topo, total // info.chips_per_host, info.chips_per_host

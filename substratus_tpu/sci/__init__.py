@@ -1,0 +1,3 @@
+from substratus_tpu.sci.client import SCIClient, FakeSCIClient
+
+__all__ = ["SCIClient", "FakeSCIClient"]

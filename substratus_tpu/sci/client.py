@@ -1,0 +1,59 @@
+"""SCI (Substratus Cloud Interface) client surface.
+
+The reference isolates cloud side-effects behind a 3-RPC gRPC service
+(internal/sci/sci.proto:6-38): CreateSignedURL, GetObjectMd5, BindIdentity.
+Same split here — controllers never talk to cloud storage/IAM directly; they
+call an SCI client. Implementations:
+
+  * FakeSCIClient       — returns canned values (reference
+                          fake_sci_client.go:9-21), for controller tests;
+  * GrpcSCIClient       — sci/grpc_transport.py, dials a real SCI server
+                          (sci/server.py serves local-FS; sci/gcp.py GCS/IAM;
+                          sci/aws.py S3/IRSA).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class SignedURL:
+    url: str
+    expiration_seconds: int = 300
+
+
+class SCIClient(ABC):
+    @abstractmethod
+    def create_signed_url(
+        self, bucket_url: str, object_path: str, md5_checksum: str,
+        expiration_seconds: int = 300,
+    ) -> SignedURL: ...
+
+    @abstractmethod
+    def get_object_md5(self, bucket_url: str, object_path: str) -> Optional[str]:
+        """None when the object does not exist."""
+
+    @abstractmethod
+    def bind_identity(self, principal: str, namespace: str, name: str) -> None:
+        """Bind a cloud principal to the k8s ServiceAccount ns/name."""
+
+
+class FakeSCIClient(SCIClient):
+    def __init__(self):
+        self.bound = []  # (principal, namespace, name)
+        self.md5s = {}  # object_path -> md5
+
+    def create_signed_url(self, bucket_url, object_path, md5_checksum,
+                          expiration_seconds=300) -> SignedURL:
+        return SignedURL(
+            url=f"https://signed.invalid/{object_path}?md5={md5_checksum}",
+            expiration_seconds=expiration_seconds,
+        )
+
+    def get_object_md5(self, bucket_url, object_path) -> Optional[str]:
+        return self.md5s.get(object_path)
+
+    def bind_identity(self, principal, namespace, name) -> None:
+        self.bound.append((principal, namespace, name))

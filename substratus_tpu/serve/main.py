@@ -77,11 +77,12 @@ def main(argv=None) -> int:
         model_name = name
 
     if quantize == "int8":
-        from substratus_tpu.ops.quant import quantize_params
+        from substratus_tpu.ops.quant import is_quantized, quantize_params
 
-        params = jax.jit(
-            lambda p: quantize_params(p, llama.quant_contracting(cfg))
-        )(params)
+        if not is_quantized(params):  # int8 artifacts arrive pre-quantized
+            params = jax.jit(
+                lambda p: quantize_params(p, llama.quant_contracting(cfg))
+            )(params)
 
     ec = EngineConfig(
         max_batch=max_batch,

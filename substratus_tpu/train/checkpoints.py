@@ -81,15 +81,29 @@ def maybe_restore_orbax(
         return None
     import orbax.checkpoint as ocp
     from substratus_tpu.models import llama
-    from substratus_tpu.parallel.sharding import DEFAULT_RULES, logical_sharding
+    from substratus_tpu.parallel.sharding import DEFAULT_RULES
 
     with open(meta_path) as f:
         meta = json.load(f)
     cfg = _cfg_from_dict(meta["model_config"])
-    shapes = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.key(0)))
+    if meta.get("quantize") == "int8":
+        from substratus_tpu.ops.quant import quantize_params
+
+        shapes = jax.eval_shape(
+            lambda: quantize_params(
+                llama.init_params(cfg, jax.random.key(0)),
+                llama.quant_contracting(cfg),
+            )
+        )
+    else:
+        shapes = jax.eval_shape(
+            lambda: llama.init_params(cfg, jax.random.key(0))
+        )
     if mesh is not None:
-        shardings = logical_sharding(
-            mesh, llama.param_logical_axes(cfg), rules or DEFAULT_RULES
+        from substratus_tpu.parallel.sharding import sharding_tree
+
+        shardings = sharding_tree(
+            shapes, mesh, llama.param_logical_axes(cfg), rules or DEFAULT_RULES
         )
     else:
         one = jax.sharding.SingleDeviceSharding(jax.devices()[0])

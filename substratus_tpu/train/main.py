@@ -75,11 +75,12 @@ def main(argv=None) -> int:
             cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
 
     if p.get("quantize") == "int8" and params is not None:
-        from substratus_tpu.ops.quant import quantize_params
+        from substratus_tpu.ops.quant import is_quantized, quantize_params
 
-        params = jax.jit(
-            lambda x: quantize_params(x, llama.quant_contracting(cfg))
-        )(params)
+        if not is_quantized(params):  # int8 artifacts arrive pre-quantized
+            params = jax.jit(
+                lambda x: quantize_params(x, llama.quant_contracting(cfg))
+            )(params)
 
     n_dev = len(jax.devices())
     mesh = build_mesh(

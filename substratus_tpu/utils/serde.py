@@ -1,0 +1,75 @@
+"""Dataclass <-> Kubernetes-style JSON (camelCase, omit-empty) conversion.
+
+The reference gets this from Go struct tags + controller-gen
+(api/v1/*_types.go); here a single generic converter keeps the API types
+declarative: snake_case dataclass fields serialize as camelCase, None/empty
+values are omitted (k8s omitempty semantics), nested dataclasses, lists and
+dicts recurse.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+
+def camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def to_dict(obj: Any) -> Any:
+    """Dataclass tree -> plain JSON-able dict (camelCase, omit empty)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            v = to_dict(getattr(obj, f.name))
+            if v is None or v == {} or v == []:
+                continue
+            out[camel(f.name)] = v
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items() if v is not None}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _resolve(tp: Any) -> Any:
+    """Unwrap Optional[X] -> X."""
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: Type[T], data: Optional[Dict[str, Any]]) -> Optional[T]:
+    """Inverse of to_dict. Unknown keys are ignored (k8s forward compat)."""
+    if data is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return data  # type: ignore[return-value]
+    hints = typing.get_type_hints(cls)
+    kwargs: Dict[str, Any] = {}
+    by_camel = {camel(f.name): f for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        f = by_camel.get(key)
+        if f is None:
+            continue
+        tp = _resolve(hints[f.name])
+        origin = get_origin(tp)
+        if dataclasses.is_dataclass(tp) and isinstance(value, dict):
+            kwargs[f.name] = from_dict(tp, value)
+        elif origin in (list, typing.List) and value is not None:
+            (item_tp,) = get_args(tp) or (Any,)
+            item_tp = _resolve(item_tp)
+            if dataclasses.is_dataclass(item_tp):
+                kwargs[f.name] = [from_dict(item_tp, v) for v in value]
+            else:
+                kwargs[f.name] = list(value)
+        else:
+            kwargs[f.name] = value
+    return cls(**kwargs)  # type: ignore[call-arg]

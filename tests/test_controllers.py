@@ -1,0 +1,269 @@
+"""Controller integration tests — the envtest tier (SURVEY.md §4 tier 2).
+
+Same technique as the reference's suite: full manager wired exactly like prod
+but against a fake apiserver, data plane faked by patching Job/Pod/Deployment
+status (reference internal/controller/main_test.go:245-265). Unlike envtest
+the fake is synchronous, so assertions run after run_until_idle() with no
+Eventually-polling.
+"""
+import pytest
+
+from substratus_tpu.cloud.base import LocalCloud
+from substratus_tpu.cloud.common import CommonConfig
+from substratus_tpu.controller.manager_main import build_manager
+from substratus_tpu.kube.fake import FakeKube
+from substratus_tpu.sci.client import FakeSCIClient
+
+
+@pytest.fixture()
+def env():
+    client = FakeKube()
+    cloud = LocalCloud(
+        CommonConfig(
+            cluster_name="testcluster",
+            artifact_bucket_url="local:///bucket",
+            registry_url="registry.local:5000",
+            principal="test-principal",
+        )
+    )
+    sci = FakeSCIClient()
+    mgr = build_manager(client, cloud, sci)
+    return client, cloud, sci, mgr
+
+
+def _dataset(name="squad", image="img:1"):
+    return {
+        "apiVersion": "substratus.ai/v1",
+        "kind": "Dataset",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"image": image, "params": {"source": "http://x"}},
+    }
+
+
+def _model(name="m", image="img:2", **spec):
+    return {
+        "apiVersion": "substratus.ai/v1",
+        "kind": "Model",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"image": image, **spec},
+    }
+
+
+def test_dataset_flow(env):
+    client, cloud, sci, mgr = env
+    client.create(_dataset())
+    mgr.run_until_idle()
+
+    job = client.get("Job", "default", "squad-data-loader")
+    tmpl = job["spec"]["template"]["spec"]
+    assert tmpl["serviceAccountName"] == "data-loader"
+    mounts = tmpl["containers"][0]["volumeMounts"]
+    paths = {m["mountPath"] for m in mounts}
+    assert "/content/artifacts" in paths and "/content/params.json" in paths
+
+    cm = client.get("ConfigMap", "default", "squad-dataset-params")
+    assert '"source": "http://x"' in cm["data"]["params.json"]
+
+    ds = client.get("Dataset", "default", "squad")
+    assert ds["status"]["ready"] is False
+    assert ds["status"]["artifacts"]["url"].startswith("local:///bucket/")
+
+    client.mark_job_complete("default", "squad-data-loader")
+    mgr.run_until_idle()
+    ds = client.get("Dataset", "default", "squad")
+    assert ds["status"]["ready"] is True
+    assert any(
+        c["type"] == "Complete" and c["status"] == "True"
+        for c in ds["status"]["conditions"]
+    )
+    # identity bound for the workload SA
+    assert ("local-default-data-loader", "default", "data-loader") in sci.bound
+
+
+def test_model_waits_for_dataset_then_trains(env):
+    client, cloud, sci, mgr = env
+    client.create(_model(name="ft", dataset={"name": "squad"}))
+    mgr.run_until_idle()
+    m = client.get("Model", "default", "ft")
+    conds = {c["type"]: c for c in m["status"]["conditions"]}
+    assert conds["Complete"]["reason"] == "DatasetNotFound"
+
+    client.create(_dataset())
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "squad-data-loader")
+    mgr.run_until_idle()  # dataset ready -> index wakeup -> model job
+
+    job = client.get("Job", "default", "ft-modeller")
+    mounts = job["spec"]["template"]["spec"]["containers"][0]["volumeMounts"]
+    by_path = {m["mountPath"]: m for m in mounts}
+    assert by_path["/content/data"]["readOnly"] is True
+    assert by_path["/content/artifacts"].get("readOnly", False) is False
+
+    client.mark_job_complete("default", "ft-modeller")
+    mgr.run_until_idle()
+    assert client.get("Model", "default", "ft")["status"]["ready"] is True
+
+
+def test_model_multihost_tpu_jobset(env):
+    client, cloud, sci, mgr = env
+    client.create(
+        _model(
+            name="big",
+            resources={"tpu": {"type": "v5e", "chips": 16}},
+        )
+    )
+    mgr.run_until_idle()
+
+    js = client.get("JobSet", "default", "big-modeller")
+    job_tmpl = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job_tmpl["completions"] == 4  # 16 chips / 4 per host
+    assert job_tmpl["completionMode"] == "Indexed"
+    assert job_tmpl["backoffLimit"] == 0  # accelerator jobs don't blind-retry
+    pod = job_tmpl["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    env_names = {e["name"] for e in c["env"]}
+    assert {"TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+            "MEGASCALE_COORDINATOR_ADDRESS"} <= env_names
+    # headless service for stable worker DNS
+    svc = client.get("Service", "default", "big-modeller")
+    assert svc["spec"]["clusterIP"] == "None"
+
+    client.mark_jobset_complete("default", "big-modeller")
+    mgr.run_until_idle()
+    assert client.get("Model", "default", "big")["status"]["ready"] is True
+
+
+def test_tpu_gke_node_selectors():
+    from substratus_tpu.api.common import Resources, TPUResources
+    from substratus_tpu.resources.apply import apply_resources
+
+    pod_md, pod_spec, container = {}, {}, {}
+    info = apply_resources(
+        pod_md, pod_spec, container, "gcp",
+        Resources(tpu=TPUResources(type="v5e", chips=4)),
+    )
+    assert info["num_hosts"] == 1
+    sel = pod_spec["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+
+
+def test_server_flow(env):
+    client, cloud, sci, mgr = env
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "srv", "namespace": "default"},
+            "spec": {"image": "img:3", "model": {"name": "base"}},
+        }
+    )
+    mgr.run_until_idle()
+    srv = client.get("Server", "default", "srv")
+    conds = {c["type"]: c for c in srv["status"]["conditions"]}
+    assert conds["Serving"]["reason"] == "ModelNotFound"
+
+    client.create(_model(name="base"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    mgr.run_until_idle()  # model ready -> server deploys
+
+    dep = client.get("Deployment", "default", "srv-server")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["readinessProbe"]["httpGet"]["path"] == "/"
+    assert {"containerPort": 8080, "name": "http-serve"} in c["ports"]
+    svc = client.get("Service", "default", "srv-server")
+    assert svc["spec"]["ports"][0]["targetPort"] == "http-serve"
+
+    client.mark_deployment_ready("default", "srv-server")
+    mgr.run_until_idle()
+    srv = client.get("Server", "default", "srv")
+    assert srv["status"]["ready"] is True
+
+
+def test_notebook_suspend_resume(env):
+    client, cloud, sci, mgr = env
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "default"},
+            "spec": {"image": "img:4"},
+        }
+    )
+    mgr.run_until_idle()
+    pod = client.get("Pod", "default", "nb-notebook")
+    c = pod["spec"]["containers"][0]
+    assert c["readinessProbe"]["httpGet"]["port"] == 8888
+
+    client.mark_pod_ready("default", "nb-notebook")
+    mgr.run_until_idle()
+    assert client.get("Notebook", "default", "nb")["status"]["ready"] is True
+
+    nb = client.get("Notebook", "default", "nb")
+    nb["spec"]["suspend"] = True
+    client.update(nb)
+    mgr.run_until_idle()
+    assert client.get_or_none("Pod", "default", "nb-notebook") is None
+    assert client.get("Notebook", "default", "nb")["status"]["ready"] is False
+
+
+def test_build_upload_flow(env):
+    client, cloud, sci, mgr = env
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Model",
+            "metadata": {"name": "up", "namespace": "default"},
+            "spec": {
+                "build": {
+                    "upload": {"md5Checksum": "abc123", "requestId": "r1"}
+                }
+            },
+        }
+    )
+    mgr.run_until_idle()
+    m = client.get("Model", "default", "up")
+    bu = m["status"]["buildUpload"]
+    assert bu["requestId"] == "r1" and "abc123" in bu["signedUrl"]
+    conds = {c["type"]: c for c in m["status"]["conditions"]}
+    assert conds["Uploaded"]["status"] == "False"
+
+    # client PUTs the tarball; storage now reports the md5
+    sci.md5s["uploads/default/models/up/abc123.tar.gz"] = "abc123"
+    mgr.enqueue("Model", "default", "up")
+    mgr.run_until_idle()
+
+    job = client.get("Job", "default", "up-model-bld")
+    assert job["metadata"]["annotations"]["image"].endswith(
+        "testcluster-model-default-up:latest"
+    )
+    client.mark_job_complete("default", "up-model-bld")
+    mgr.run_until_idle()
+    m = client.get("Model", "default", "up")
+    assert m["spec"]["image"].endswith("testcluster-model-default-up:latest")
+    conds = {c["type"]: c for c in m["status"]["conditions"]}
+    assert conds["Built"]["status"] == "True"
+
+
+def test_secret_env_resolution():
+    from substratus_tpu.controller.workloads import resolve_env
+
+    out = resolve_env(
+        {"PLAIN": "v", "TOKEN": "${{ secrets.hf-creds.token }}"}
+    )
+    by_name = {e["name"]: e for e in out}
+    assert by_name["PLAIN"]["value"] == "v"
+    assert by_name["TOKEN"]["valueFrom"]["secretKeyRef"] == {
+        "name": "hf-creds", "key": "token",
+    }
+
+
+def test_artifact_addressing_stability():
+    from substratus_tpu.cloud.common import object_hash
+
+    h1 = object_hash("c", "ns", "Model", "m")
+    h2 = object_hash("c", "ns", "Model", "m")
+    assert h1 == h2 and len(h1) == 32
+    assert h1 != object_hash("c", "ns", "Model", "m2")

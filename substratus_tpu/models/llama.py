@@ -47,6 +47,13 @@ class LlamaConfig:
     max_seq_len: int = 4096
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # Self-attention (no-cache path) implementation:
+    #   "xla"   — einsum + masked softmax (always correct; CPU tests)
+    #   "flash" — Pallas blockwise kernel (ops/flash_attention.py, TPU)
+    #   "ring"  — sequence-parallel ring attention (ops/ring_attention.py);
+    #             requires an ambient mesh (jax.sharding.use_mesh) with a
+    #             "sequence" axis
+    attn_impl: str = "xla"
 
     @property
     def head_size(self) -> int:
@@ -172,6 +179,36 @@ def cache_logical_axes(cfg: LlamaConfig) -> Params:
     return {"k": ax, "v": ax}
 
 
+def _self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    positions: jnp.ndarray,
+    cfg: LlamaConfig,
+) -> jnp.ndarray:
+    """No-cache causal attention, dispatched per cfg.attn_impl. The fused
+    kernels assume standard positions (row r attends 0..r within the same
+    sequence), which holds for training and full prefill."""
+    if cfg.attn_impl == "flash":
+        from substratus_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, True)
+    if cfg.attn_impl == "ring":
+        from jax.sharding import PartitionSpec as P
+
+        from substratus_tpu.ops.ring_attention import ring_attention
+
+        spec = P(None, "sequence", None, None)
+        ring = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis_name="sequence"),
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"sequence"},
+        )
+        return ring(q, k, v)
+    return dot_product_attention(q, k, v, causal=True, q_positions=positions)
+
+
 def _lora_delta(
     h: jnp.ndarray, adapter, scale, out_einsum: str
 ) -> jnp.ndarray:
@@ -210,7 +247,7 @@ def _block(
     kk = rope(kk, positions, cfg.rope_theta)
 
     if layer_cache is None:
-        attn = dot_product_attention(q, kk, vv, causal=True, q_positions=positions)
+        attn = _self_attention(q, kk, vv, positions, cfg)
         kv_out = (kk, vv)
     else:
         k_cache, v_cache = layer_cache  # [B, S, KH, hd]

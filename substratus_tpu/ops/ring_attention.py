@@ -1,0 +1,106 @@
+"""Ring attention: causal attention over sequence-sharded q/k/v.
+
+The long-context strategy (SURVEY.md §2.3/§5 — absent from the reference,
+first-class here): each device on the "sequence" mesh axis holds one
+contiguous sequence shard; k/v blocks rotate around the ring via
+`jax.lax.ppermute` (which XLA lowers to ICI neighbor transfers) while every
+device folds each visiting block into its local queries with the same
+online-softmax accumulation flash attention uses. HBM/VMEM hold only
+O(S/n) of the sequence per device, so max context scales linearly with the
+ring size; compute-communication overlap is XLA's job (each step's matmul
+overlaps the next block's ppermute).
+
+Causality with a ring: shard i's queries attend to shard j's keys iff
+j <= i (block-causal across shards, elementwise-causal on the diagonal
+shard); non-attending steps are skipped via jnp.where on the accumulators
+(uniform control flow keeps the collective schedule identical on all
+devices).
+
+Usage: inside shard_map over a mesh with a "sequence" axis — see
+models/llama.py attention dispatch and tests/test_ring_attention.py.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, Sl, H, D] local query shard
+    k: jnp.ndarray,  # [B, Sl, KH, D] local key shard
+    v: jnp.ndarray,  # [B, Sl, KH, D]
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Runs under shard_map; q/k/v are the local sequence shards."""
+    b, sl, h, d = q.shape
+    kh = k.shape[2]
+    group = h // kh
+    if scale is None:
+        scale = d**-0.5
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    qf = (q.astype(jnp.float32) * scale).reshape(b, sl, kh, group, d)
+
+    # Online-softmax accumulators, derived from qf so they carry the same
+    # shard_map varying-axes as the data (fresh constants would be
+    # device-invariant and fail scan's carry type check).
+    m = qf[..., :1] * 0.0 + NEG_INF
+    l = qf[..., :1] * 0.0
+    acc = qf * 0.0
+
+    def fold_block(m, l, acc, kk, vv, src):
+        """Fold one visiting k/v block into the accumulators. `src` is the
+        ring position the block originated at (uniform across devices)."""
+        s = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qf, kk.astype(jnp.float32)
+        )  # [B, KH, G, Sl, Sl]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
+            diag_mask = cols <= rows  # within-shard causal
+            on_diag = src == my_idx
+            before = src < my_idx
+            keep = jnp.where(
+                on_diag, diag_mask, jnp.broadcast_to(before, (sl, sl))
+            )
+            s = jnp.where(keep[None, None, None, :, :], s, NEG_INF)
+
+        # s: [B, KH, G, Sq, Sk]; accumulators are [B, Sq, KH, G, ...]
+        m_cur = jnp.max(s, axis=-1)[..., None].transpose(0, 3, 1, 2, 4)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new.transpose(0, 2, 3, 1, 4))  # [B,KH,G,Sq,Sk]
+        alpha = jnp.exp(m - m_new)  # [B,Sq,KH,G,1]
+        pv = jnp.einsum("bkgqs,bskd->bqkgd", p, vv.astype(jnp.float32))
+        l = alpha * l + jnp.sum(p, axis=-1)[..., None].transpose(0, 3, 1, 2, 4)
+        acc = acc * alpha + pv
+        return m_new, l, acc
+
+    # Step 0: the local block, no communication.
+    m, l, acc = fold_block(m, l, acc, k, v, my_idx)
+
+    # Steps 1..n-1: rotate, then fold — exactly n-1 ppermutes total (a
+    # trailing rotate-after-last-fold would be dead ICI traffic).
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, step_idx):
+        m, l, acc, kk, vv = carry
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        src = (my_idx - step_idx) % n
+        m, l, acc = fold_block(m, l, acc, kk, vv, src)
+        return (m, l, acc, kk, vv), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m, l, acc, k, v), jnp.arange(1, n)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l).reshape(b, sl, h, d)
+    return out.astype(q.dtype)

@@ -1,0 +1,59 @@
+"""Multi-host distributed bootstrap (greenfield; SURVEY.md §2.3/§5).
+
+The comms backend is XLA collectives over ICI within a slice and DCN across
+slices; what this module adds is the *rendezvous*: turning the env the
+operator injects into JobSet pods (controller/workloads.py — TPU_WORKER_ID,
+TPU_WORKER_HOSTNAMES, JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES) into a
+`jax.distributed.initialize` call, the way the reference ecosystem relied on
+NCCL/MPI env bootstraps (MASTER_ADDR/WORLD_SIZE) that the reference operator
+itself never provided.
+
+Call `maybe_initialize()` first thing in any entrypoint; it is a no-op for
+single-host runs so the same containers work everywhere.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("substratus.distributed")
+
+_initialized = False
+
+
+def world_info() -> tuple[Optional[str], int, int]:
+    """(coordinator_address, num_processes, process_id) from operator env."""
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    pid_raw = os.environ.get("TPU_WORKER_ID", "0") or "0"
+    try:
+        pid = int(pid_raw)
+    except ValueError:
+        pid = 0
+    return coord, n, pid
+
+
+def maybe_initialize(timeout_seconds: int = 300) -> bool:
+    """Initialize jax.distributed when the operator wired a multi-host slice;
+    no-op (returns False) on single-host. Idempotent."""
+    global _initialized
+    if _initialized:
+        return True
+    coord, n, pid = world_info()
+    if n <= 1 or coord is None:
+        return False
+    import jax
+
+    log.info(
+        "jax.distributed.initialize(coordinator=%s, processes=%d, id=%d)",
+        coord, n, pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=n,
+        process_id=pid,
+        initialization_timeout=timeout_seconds,
+    )
+    _initialized = True
+    return True

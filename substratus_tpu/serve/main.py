@@ -84,6 +84,11 @@ def main(argv=None) -> int:
                 lambda p: quantize_params(p, llama.quant_contracting(cfg))
             )(params)
 
+    # Serving picks its own attention impl (never inherited from training):
+    # XLA reference by default; params.json {"attn_impl": "flash"} opts a
+    # TPU server into the Pallas prefill kernel.
+    cfg = cfg.replace(attn_impl=params_json.get("attn_impl", "xla"))
+
     ec = EngineConfig(
         max_batch=max_batch,
         max_seq_len=min(max_seq_len, cfg.max_seq_len),

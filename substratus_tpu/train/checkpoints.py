@@ -31,6 +31,9 @@ META_FILE = "substratus.json"
 def _cfg_to_dict(cfg: LlamaConfig) -> Dict[str, Any]:
     d = dataclasses.asdict(cfg)
     d["dtype"] = np.dtype(cfg.dtype).name if cfg.dtype is not None else "bfloat16"
+    # attn_impl is an execution-context choice (mesh/hardware dependent),
+    # not model architecture: never persist it into artifacts.
+    d.pop("attn_impl", None)
     return d
 
 

@@ -220,10 +220,13 @@ class Trainer:
             lambda x: jax.device_put(x, self.batch_sharding), batch
         )
         trainable = self.lora if self.lora is not None else self.params
-        trainable, self.opt_state, loss = self._train_step(
-            trainable, self.params if self.lora is not None else None,
-            self.opt_state, batch,
-        )
+        # Ambient mesh: the ring-attention path (cfg.attn_impl == "ring")
+        # opens a shard_map over the "sequence" axis inside the jitted step.
+        with jax.set_mesh(self.mesh):
+            trainable, self.opt_state, loss = self._train_step(
+                trainable, self.params if self.lora is not None else None,
+                self.opt_state, batch,
+            )
         if self.lora is not None:
             self.lora = trainable
         else:

@@ -1,0 +1,40 @@
+# Dev targets (reference: Makefile:80-290 — manifests/generate/protogen/
+# test tiers/installation-manifests).
+
+PY ?= python
+
+.PHONY: test test-int manifests protogen nbwatch bench graft image install-manifests
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# Controller integration tier only (fake apiserver; reference
+# `make test-integration`).
+test-int:
+	$(PY) -m pytest tests/test_controllers.py tests/test_sci.py -q
+
+manifests:
+	$(PY) -m substratus_tpu.api.crdgen > config/crd/substratus-crds.yaml
+
+protogen:
+	protoc --python_out=substratus_tpu/sci --proto_path=substratus_tpu/sci \
+	  substratus_tpu/sci/sci.proto
+
+nbwatch:
+	g++ -O2 -Wall -o native/nbwatch native/nbwatch.cc
+
+bench:
+	$(PY) bench.py
+
+graft:
+	$(PY) __graft_entry__.py
+
+image:
+	docker build -t ghcr.io/substratus-tpu/runtime:latest .
+
+# Single-file install manifest (reference `make installation-manifests`).
+# Explicit --- separators: bare concatenation merges adjacent YAML docs.
+install-manifests: manifests
+	{ cat config/crd/substratus-crds.yaml; echo '---'; \
+	  cat config/manager/manager.yaml; echo '---'; \
+	  cat config/sci/deployment.yaml; } > install/substratus-tpu.yaml

@@ -1,0 +1,132 @@
+// nbwatch: recursive filesystem watcher for the notebook file-sync loop.
+//
+// Native (C++/inotify) counterpart of the reference's Go/fsnotify tool
+// (reference containertools/cmd/nbwatch/main.go:30-99): watches a root
+// directory (default /content) recursively, skipping the artifact mounts
+// ("data", "model", "artifacts") and dotfiles, and emits one JSON line per
+// event on stdout:
+//
+//   {"index":0,"path":"/content/train.py","op":"WRITE"}
+//
+// The client streams these over `kubectl exec` and mirrors changed files
+// back to the laptop (substratus_tpu/client/sync.py).
+//
+// Build: g++ -O2 -o nbwatch native/nbwatch.cc   (make nbwatch)
+#include <sys/inotify.h>
+#include <dirent.h>
+#include <errno.h>
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <map>
+#include <string>
+
+static const char *kSkipDirs[] = {"data", "model", "artifacts"};
+
+static bool should_skip(const char *name) {
+  if (name[0] == '.') return true;
+  for (const char *skip : kSkipDirs) {
+    if (strcmp(name, skip) == 0) return true;
+  }
+  return false;
+}
+
+struct Watcher {
+  int fd;
+  std::map<int, std::string> dirs;  // wd -> absolute dir path
+
+  bool add(const std::string &path) {
+    int wd = inotify_add_watch(
+        fd, path.c_str(),
+        IN_CREATE | IN_CLOSE_WRITE | IN_DELETE | IN_MOVED_FROM | IN_MOVED_TO);
+    if (wd < 0) {
+      fprintf(stderr, "nbwatch: watch %s: %s\n", path.c_str(),
+              strerror(errno));
+      return false;
+    }
+    dirs[wd] = path;
+    return true;
+  }
+
+  // Watch dir and all non-skipped subdirectories.
+  void add_recursive(const std::string &root, bool is_root) {
+    if (!add(root)) return;
+    DIR *d = opendir(root.c_str());
+    if (!d) return;
+    struct dirent *e;
+    while ((e = readdir(d)) != nullptr) {
+      if (e->d_type != DT_DIR) continue;
+      if (strcmp(e->d_name, ".") == 0 || strcmp(e->d_name, "..") == 0)
+        continue;
+      // Skip mounts/dotfiles only at the top level (reference behavior:
+      // non-special subdirs are watched fully).
+      if (is_root && should_skip(e->d_name)) continue;
+      if (e->d_name[0] == '.') continue;
+      add_recursive(root + "/" + e->d_name, false);
+    }
+    closedir(d);
+  }
+};
+
+static void json_escape(const char *in, char *out, size_t cap) {
+  size_t j = 0;
+  for (size_t i = 0; in[i] && j + 2 < cap; i++) {
+    if (in[i] == '"' || in[i] == '\\') out[j++] = '\\';
+    out[j++] = in[i];
+  }
+  out[j] = 0;
+}
+
+int main(int argc, char **argv) {
+  const char *root = argc > 1 ? argv[1] : "/content";
+  Watcher w;
+  w.fd = inotify_init1(IN_CLOEXEC);
+  if (w.fd < 0) {
+    perror("inotify_init1");
+    return 1;
+  }
+  w.add_recursive(root, true);
+
+  char buf[64 * 1024]
+      __attribute__((aligned(__alignof__(struct inotify_event))));
+  long index = 0;
+  for (;;) {
+    ssize_t len = read(w.fd, buf, sizeof(buf));
+    if (len <= 0) {
+      if (errno == EINTR) continue;
+      perror("read");
+      return 1;
+    }
+    for (char *p = buf; p < buf + len;) {
+      struct inotify_event *ev = (struct inotify_event *)p;
+      p += sizeof(struct inotify_event) + ev->len;
+      if (ev->len == 0) continue;
+      if (ev->name[0] == '.') continue;
+      auto it = w.dirs.find(ev->wd);
+      if (it == w.dirs.end()) continue;
+      std::string path = it->second + "/" + ev->name;
+
+      if ((ev->mask & IN_ISDIR) && (ev->mask & (IN_CREATE | IN_MOVED_TO))) {
+        // New directory: start watching it (unless skipped at top level).
+        if (!(it->second == root && should_skip(ev->name))) {
+          w.add_recursive(path, false);
+        }
+        continue;
+      }
+      if (ev->mask & IN_ISDIR) continue;
+      if (it->second == root && should_skip(ev->name)) continue;
+
+      const char *op = (ev->mask & (IN_DELETE | IN_MOVED_FROM)) ? "REMOVE"
+                       : (ev->mask & IN_CREATE)                 ? "CREATE"
+                                                                : "WRITE";
+      char escaped[PATH_MAX * 2];
+      json_escape(path.c_str(), escaped, sizeof(escaped));
+      printf("{\"index\":%ld,\"path\":\"%s\",\"op\":\"%s\"}\n", index++,
+             escaped, op);
+      fflush(stdout);
+    }
+  }
+}

@@ -1,0 +1,104 @@
+"""CRD manifest generation from the dataclass API types.
+
+The reference generates config/crd/bases via controller-gen struct tags;
+here the same artifact is derived from the dataclasses themselves:
+
+    python -m substratus_tpu.api.crdgen > config/crd/substratus-crds.yaml
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, get_args, get_origin
+
+import yaml
+
+from substratus_tpu.api import types as T
+from substratus_tpu.utils.serde import camel
+
+_SCALARS = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+}
+
+
+def _schema(tp: Any) -> Dict[str, Any]:
+    origin = get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _schema(args[0])
+    if tp in _SCALARS:
+        return dict(_SCALARS[tp])
+    if origin in (list, typing.List):
+        (item,) = get_args(tp) or (str,)
+        return {"type": "array", "items": _schema(item)}
+    if origin in (dict, typing.Dict):
+        return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if dataclasses.is_dataclass(tp):
+        props = {}
+        hints = typing.get_type_hints(tp)
+        for f in dataclasses.fields(tp):
+            props[camel(f.name)] = _schema(hints[f.name])
+        return {"type": "object", "properties": props}
+    return {"x-kubernetes-preserve-unknown-fields": True, "type": "object"}
+
+
+def crd_for(kind: str) -> Dict[str, Any]:
+    spec_cls = {
+        "Dataset": T.DatasetSpec,
+        "Model": T.ModelSpec,
+        "Notebook": T.NotebookSpec,
+        "Server": T.ServerSpec,
+    }[kind]
+    plural = T.PLURALS[kind]
+    status_schema = _schema(T.CommonStatus)
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{T.GROUP}"},
+        "spec": {
+            "group": T.GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": T.VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Ready",
+                            "type": "boolean",
+                            "jsonPath": ".status.ready",
+                        }
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": _schema(spec_cls),
+                                "status": status_schema,
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def render_all() -> str:
+    docs = [crd_for(kind) for kind in T.KINDS]
+    return yaml.safe_dump_all(docs, sort_keys=False)
+
+
+if __name__ == "__main__":
+    print(render_all())

@@ -66,8 +66,43 @@ def run_notebook(args, client) -> int:
     if args.fake:
         print("fake mode: skipping port-forward/browser")
         return 0
-    url = f"http://localhost:8888?token=default"
-    print(f"notebook ready; port-forward pod/{name}-notebook 8888 and open {url}")
+
+    # Dev loop: file-sync + port-forward in the background, browser in front
+    # (reference tui/notebook.go:65-91 composition).
+    import threading
+
+    from substratus_tpu.cli.sync import port_forward, sync_files_from_notebook
+
+    stop = threading.Event()
+    pod = f"{name}-notebook"
+    threading.Thread(
+        target=sync_files_from_notebook,
+        args=(ns, pod, os.getcwd()),
+        kwargs={"stop": stop, "on_event": lambda e: print(f"  sync: {e['op']} {e['path']}")},
+        daemon=True,
+    ).start()
+    forward = threading.Thread(
+        target=port_forward, args=(ns, pod, 8888, 8888),
+        kwargs={"stop": stop}, daemon=True,
+    )
+    forward.start()
+
+    # Open the browser only once something is listening locally.
+    import socket
+
+    url = "http://localhost:8888?token=default"
+    for _ in range(60):
+        try:
+            with socket.create_connection(("localhost", 8888), timeout=0.5):
+                break
+        except OSError:
+            time.sleep(0.5)
+    print(f"notebook ready; forwarding :8888, opening {url} (ctrl-c to stop)")
     if not args.no_open:
         webbrowser.open(url)
+    try:
+        while forward.is_alive():
+            forward.join(timeout=1.0)
+    except KeyboardInterrupt:
+        stop.set()
     return 0

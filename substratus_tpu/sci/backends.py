@@ -1,0 +1,290 @@
+"""SCI backends: the cloud side-effect implementations behind the gRPC
+service.
+
+  * LocalFSBackend — signed-URL emulation over the local filesystem + a
+    plain HTTP PUT handler (reference internal/sci/kind/server.go:27-110):
+    the test double that makes the whole control plane runnable on kind or
+    in CI with zero cloud credentials.
+  * GCSBackend — V4 signed PUT URLs via IAM SignBlob, object MD5 from GCS
+    metadata, workload-identity binding via IAM policy edit (reference
+    internal/sci/gcp/manager.go:50-144). Requires google-cloud libraries +
+    credentials at runtime; import is deferred and failures are explicit.
+  * S3Backend — presigned PUT with Content-MD5, ETag-as-MD5, IRSA trust
+    policy editing (reference internal/sci/aws/server.go:36-162). Requires
+    boto3 at runtime.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import http.server
+import os
+import threading
+import urllib.parse
+from abc import ABC, abstractmethod
+from typing import Optional
+
+
+class SCIBackend(ABC):
+    @abstractmethod
+    def create_signed_url(
+        self, bucket: str, object_name: str, md5_checksum: str,
+        expiration_seconds: int,
+    ) -> str: ...
+
+    @abstractmethod
+    def get_object_md5(self, bucket: str, object_name: str) -> Optional[str]: ...
+
+    @abstractmethod
+    def bind_identity(self, principal: str, namespace: str, name: str) -> None: ...
+
+
+def split_bucket_url(bucket_url: str) -> tuple:
+    """gs://bucket/prefix | s3://bucket/prefix -> (bucket, prefix).
+
+    Bucket URLs may carry a path prefix; every backend must resolve objects
+    under it, because the rest of the system (kaniko build context,
+    controller addressing) composes `{bucket_url}/{object_path}`."""
+    for scheme in ("gs://", "s3://", "local://"):
+        if bucket_url.startswith(scheme):
+            rest = bucket_url[len(scheme):]
+            bucket, _, prefix = rest.partition("/")
+            return bucket, prefix.strip("/")
+    return bucket_url, ""
+
+
+def _prefixed(bucket_url: str, object_name: str) -> str:
+    _, prefix = split_bucket_url(bucket_url)
+    return f"{prefix}/{object_name}" if prefix else object_name
+
+
+class LocalFSBackend(SCIBackend):
+    """Bucket = a directory (`root` IS the bucket; the bucket URL's path is
+    resolved against it); signed URL = http://host:port/<object> served by
+    an embedded PUT handler that writes the file + an md5 sidecar."""
+
+    def __init__(self, root: str = "/bucket", external_host: str = "localhost",
+                 http_port: int = 30080):
+        self.root = root
+        self.external_host = external_host
+        self.http_port = http_port
+        self.bound: list = []
+        self._http_server: Optional[http.server.ThreadingHTTPServer] = None
+
+    def _path(self, bucket: str, object_name: str) -> str:
+        # The PUT handler and md5 lookup must agree on one filesystem root:
+        # self.root (deployments point --bucket-root at the bucket dir).
+        base = self.root
+        full = os.path.normpath(os.path.join(base, object_name))
+        if not full.startswith(os.path.normpath(base) + os.sep):
+            raise ValueError(f"object path escapes bucket: {object_name!r}")
+        return full
+
+    def create_signed_url(self, bucket, object_name, md5_checksum,
+                          expiration_seconds) -> str:
+        return (
+            f"http://{self.external_host}:{self.http_port}/"
+            f"{urllib.parse.quote(object_name)}?md5={md5_checksum}"
+        )
+
+    def get_object_md5(self, bucket, object_name) -> Optional[str]:
+        sidecar = self._path(bucket, object_name) + ".md5"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                return f.read().strip()
+        path = self._path(bucket, object_name)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return hashlib.md5(f.read()).hexdigest()
+        return None
+
+    def bind_identity(self, principal, namespace, name) -> None:
+        self.bound.append((principal, namespace, name))
+
+    # -- HTTP PUT handler (the "storage" side of the signed URL) -----------
+
+    def start_http(self, port: Optional[int] = None) -> int:
+        backend = self
+        port = port if port is not None else self.http_port
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(length)
+                object_name = urllib.parse.unquote(
+                    urllib.parse.urlparse(self.path).path.lstrip("/")
+                )
+                md5_hex = hashlib.md5(data).hexdigest()
+                sent = self.headers.get("Content-MD5")
+                if sent:
+                    expect = base64.b64encode(
+                        hashlib.md5(data).digest()
+                    ).decode()
+                    if sent != expect:
+                        self.send_response(400)
+                        self.end_headers()
+                        self.wfile.write(b"md5 mismatch")
+                        return
+                try:
+                    path = backend._path(backend.root, object_name)
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "wb") as f:
+                    f.write(data)
+                with open(path + ".md5", "w") as f:
+                    f.write(md5_hex)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._http_server = server
+        self.http_port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return self.http_port
+
+    def stop_http(self):
+        if self._http_server:
+            self._http_server.shutdown()
+
+
+class GCSBackend(SCIBackend):
+    """GCS/IAM implementation; requires google-cloud-storage +
+    google-api-python-client and ambient credentials."""
+
+    def __init__(self, project_id: Optional[str] = None):
+        from google.cloud import storage  # deferred; not in the dev image
+
+        self.project_id = project_id or os.environ.get("PROJECT_ID")
+        self.client = storage.Client(project=self.project_id)
+
+    def create_signed_url(self, bucket, object_name, md5_checksum,
+                          expiration_seconds) -> str:
+        import datetime
+
+        name, _ = split_bucket_url(bucket)
+        blob = self.client.bucket(name).blob(_prefixed(bucket, object_name))
+        return blob.generate_signed_url(
+            version="v4",
+            method="PUT",
+            expiration=datetime.timedelta(seconds=expiration_seconds),
+            content_md5=base64.b64encode(bytes.fromhex(md5_checksum)).decode(),
+        )
+
+    def get_object_md5(self, bucket, object_name) -> Optional[str]:
+        name, _ = split_bucket_url(bucket)
+        blob = self.client.bucket(name).get_blob(
+            _prefixed(bucket, object_name)
+        )
+        if blob is None or blob.md5_hash is None:
+            return None
+        return base64.b64decode(blob.md5_hash).hex()
+
+    def bind_identity(self, principal, namespace, name) -> None:
+        """Grant roles/iam.workloadIdentityUser on the GSA to the KSA
+        member (get-modify-set, reference gcp/manager.go:118-144)."""
+        import googleapiclient.discovery
+
+        iam = googleapiclient.discovery.build("iam", "v1")
+        resource = (
+            f"projects/{self.project_id}/serviceAccounts/{principal}"
+        )
+        member = (
+            f"serviceAccount:{self.project_id}.svc.id.goog[{namespace}/{name}]"
+        )
+        policy = (
+            iam.projects()
+            .serviceAccounts()
+            .getIamPolicy(resource=resource)
+            .execute()
+        )
+        bindings = policy.setdefault("bindings", [])
+        for b in bindings:
+            if b["role"] == "roles/iam.workloadIdentityUser":
+                if member not in b["members"]:
+                    b["members"].append(member)
+                break
+        else:
+            bindings.append(
+                {
+                    "role": "roles/iam.workloadIdentityUser",
+                    "members": [member],
+                }
+            )
+        iam.projects().serviceAccounts().setIamPolicy(
+            resource=resource, body={"policy": policy}
+        ).execute()
+
+
+class S3Backend(SCIBackend):
+    """S3/IRSA implementation; requires boto3 and ambient credentials."""
+
+    def __init__(self, oidc_provider_url: Optional[str] = None):
+        import boto3
+
+        self.s3 = boto3.client("s3")
+        self.iam = boto3.client("iam")
+        self.oidc_provider_url = oidc_provider_url or os.environ.get(
+            "OIDC_PROVIDER_URL", ""
+        )
+
+    def create_signed_url(self, bucket, object_name, md5_checksum,
+                          expiration_seconds) -> str:
+        name, _ = split_bucket_url(bucket)
+        return self.s3.generate_presigned_url(
+            "put_object",
+            Params={
+                "Bucket": name,
+                "Key": _prefixed(bucket, object_name),
+                "ContentMD5": base64.b64encode(
+                    bytes.fromhex(md5_checksum)
+                ).decode(),
+            },
+            ExpiresIn=expiration_seconds,
+        )
+
+    def get_object_md5(self, bucket, object_name) -> Optional[str]:
+        import botocore.exceptions
+
+        name, _ = split_bucket_url(bucket)
+        try:
+            head = self.s3.head_object(
+                Bucket=name, Key=_prefixed(bucket, object_name)
+            )
+        except botocore.exceptions.ClientError:
+            return None
+        # Single-part uploads: ETag is the hex md5 (reference
+        # aws/server.go:36-58).
+        return head["ETag"].strip('"')
+
+    def bind_identity(self, principal, namespace, name) -> None:
+        """Append the KSA subject to the IAM role's IRSA trust policy
+        (reference aws/server.go:88-162)."""
+        import json
+
+        role_name = principal.split("/")[-1]
+        role = self.iam.get_role(RoleName=role_name)["Role"]
+        doc = role["AssumeRolePolicyDocument"]
+        sub = f"system:serviceaccount:{namespace}:{name}"
+        provider = self.oidc_provider_url.removeprefix("https://")
+        for stmt in doc.get("Statement", []):
+            cond = stmt.setdefault("Condition", {}).setdefault(
+                "StringEquals", {}
+            )
+            key = f"{provider}:sub"
+            subs = cond.get(key)
+            if subs is None:
+                cond[key] = [sub]
+            elif isinstance(subs, list):
+                if sub not in subs:
+                    subs.append(sub)
+            elif subs != sub:
+                cond[key] = [subs, sub]
+        self.iam.update_assume_role_policy(
+            RoleName=role_name, PolicyDocument=json.dumps(doc)
+        )

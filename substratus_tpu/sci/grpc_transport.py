@@ -1,0 +1,133 @@
+"""gRPC plumbing for the SCI service: hand-rolled stubs over the protoc-
+generated messages (no grpcio-tools in the image; the service layer is
+~60 lines so a plugin buys nothing).
+
+Server: `serve(backend, port)` exposes any `SCIBackend` (local/gcp/aws) as
+the sci.v1.Controller service + standard gRPC health service semantics
+(reference cmd/sci-gcp/main.go:87-90).
+Client: `GrpcSCIClient` implements sci.client.SCIClient for controllers.
+"""
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from substratus_tpu.sci import sci_pb2 as pb
+from substratus_tpu.sci.backends import SCIBackend
+from substratus_tpu.sci.client import SCIClient, SignedURL
+
+SERVICE = "sci.v1.Controller"
+
+
+def _split_bucket(bucket_url: str) -> str:
+    """gs://bucket/prefix or local:///path -> backend-native bucket name."""
+    return bucket_url
+
+
+class GrpcSCIClient(SCIClient):
+    def __init__(self, address: str):
+        self.channel = grpc.insecure_channel(address)
+        self._signed_url = self.channel.unary_unary(
+            f"/{SERVICE}/CreateSignedURL",
+            request_serializer=pb.CreateSignedURLRequest.SerializeToString,
+            response_deserializer=pb.CreateSignedURLResponse.FromString,
+        )
+        self._md5 = self.channel.unary_unary(
+            f"/{SERVICE}/GetObjectMd5",
+            request_serializer=pb.GetObjectMd5Request.SerializeToString,
+            response_deserializer=pb.GetObjectMd5Response.FromString,
+        )
+        self._bind = self.channel.unary_unary(
+            f"/{SERVICE}/BindIdentity",
+            request_serializer=pb.BindIdentityRequest.SerializeToString,
+            response_deserializer=pb.BindIdentityResponse.FromString,
+        )
+
+    def create_signed_url(self, bucket_url, object_path, md5_checksum,
+                          expiration_seconds=300) -> SignedURL:
+        resp = self._signed_url(
+            pb.CreateSignedURLRequest(
+                bucket_name=_split_bucket(bucket_url),
+                object_name=object_path,
+                expiration_seconds=expiration_seconds,
+                md5_checksum=md5_checksum,
+            )
+        )
+        return SignedURL(url=resp.url, expiration_seconds=expiration_seconds)
+
+    def get_object_md5(self, bucket_url, object_path) -> Optional[str]:
+        resp = self._md5(
+            pb.GetObjectMd5Request(
+                bucket_name=_split_bucket(bucket_url), object_name=object_path
+            )
+        )
+        return resp.md5_checksum if resp.exists else None
+
+    def bind_identity(self, principal, namespace, name) -> None:
+        self._bind(
+            pb.BindIdentityRequest(
+                principal=principal,
+                kubernetes_namespace=namespace,
+                kubernetes_service_account=name,
+            )
+        )
+
+
+def _handlers(backend: SCIBackend) -> grpc.GenericRpcHandler:
+    def create_signed_url(request: pb.CreateSignedURLRequest, context):
+        url = backend.create_signed_url(
+            request.bucket_name,
+            request.object_name,
+            request.md5_checksum,
+            request.expiration_seconds or 300,
+        )
+        return pb.CreateSignedURLResponse(url=url)
+
+    def get_object_md5(request: pb.GetObjectMd5Request, context):
+        md5 = backend.get_object_md5(request.bucket_name, request.object_name)
+        return pb.GetObjectMd5Response(
+            md5_checksum=md5 or "", exists=md5 is not None
+        )
+
+    def bind_identity(request: pb.BindIdentityRequest, context):
+        backend.bind_identity(
+            request.principal,
+            request.kubernetes_namespace,
+            request.kubernetes_service_account,
+        )
+        return pb.BindIdentityResponse()
+
+    return grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "CreateSignedURL": grpc.unary_unary_rpc_method_handler(
+                create_signed_url,
+                request_deserializer=pb.CreateSignedURLRequest.FromString,
+                response_serializer=pb.CreateSignedURLResponse.SerializeToString,
+            ),
+            "GetObjectMd5": grpc.unary_unary_rpc_method_handler(
+                get_object_md5,
+                request_deserializer=pb.GetObjectMd5Request.FromString,
+                response_serializer=pb.GetObjectMd5Response.SerializeToString,
+            ),
+            "BindIdentity": grpc.unary_unary_rpc_method_handler(
+                bind_identity,
+                request_deserializer=pb.BindIdentityRequest.FromString,
+                response_serializer=pb.BindIdentityResponse.SerializeToString,
+            ),
+        },
+    )
+
+
+def serve(backend: SCIBackend, port: int = 10080, block: bool = True):
+    """Start the SCI gRPC server; the bound port (useful with port=0) is
+    exposed as `server.bound_port`."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((_handlers(backend),))
+    server.bound_port = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    if block:
+        server.wait_for_termination()
+    return server

@@ -1,0 +1,49 @@
+"""SCI server entrypoints (reference: cmd/sci-{gcp,aws,kind}/main.go).
+
+    python -m substratus_tpu.sci.server_main --backend local [--port 10080]
+    python -m substratus_tpu.sci.server_main --backend gcs
+    python -m substratus_tpu.sci.server_main --backend s3
+
+The local backend also starts the HTTP PUT handler that plays the storage
+side of signed URLs (reference sci-kind's NodePort 30080,
+install/kind/up.sh:6-14).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", choices=["local", "gcs", "s3"], default="local")
+    ap.add_argument("--port", type=int, default=10080)
+    ap.add_argument("--http-port", type=int, default=30080)
+    ap.add_argument("--bucket-root", default="/bucket")
+    ap.add_argument("--external-host", default="localhost")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from substratus_tpu.sci import backends
+    from substratus_tpu.sci.grpc_transport import serve
+
+    if args.backend == "local":
+        backend = backends.LocalFSBackend(
+            root=args.bucket_root,
+            external_host=args.external_host,
+            http_port=args.http_port,
+        )
+        backend.start_http()
+        logging.info("local storage HTTP PUT handler on :%d", backend.http_port)
+    elif args.backend == "gcs":
+        backend = backends.GCSBackend()
+    else:
+        backend = backends.S3Backend()
+
+    logging.info("SCI gRPC (%s backend) on :%d", args.backend, args.port)
+    serve(backend, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,7 +24,7 @@ from substratus_tpu.models.llama import CONFIGS, LlamaConfig, Params
 
 
 def config_from_hf(hf_cfg: Any) -> LlamaConfig:
-    """Map a transformers LlamaConfig(-like) object to LlamaConfig."""
+    """Map a transformers Llama/Mistral/MixtralConfig(-like) to LlamaConfig."""
     get = lambda name, default=None: getattr(hf_cfg, name, default)
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
@@ -38,6 +38,9 @@ def config_from_hf(hf_cfg: Any) -> LlamaConfig:
         norm_eps=get("rms_norm_eps", 1e-5),
         max_seq_len=get("max_position_embeddings", 4096),
         tie_embeddings=bool(get("tie_word_embeddings", False)),
+        # Mixtral MoE fields
+        n_experts=get("num_local_experts", 0) or 0,
+        n_experts_per_token=get("num_experts_per_tok", 2) or 2,
     )
 
 
@@ -86,12 +89,57 @@ def convert_llama_state_dict(
                 lambda w: w.T.reshape(H, hd, D),
             ),
             "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight", lambda w: w),
-            "w_gate": stack("layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
-            "w_up": stack("layers.{i}.mlp.up_proj.weight", lambda w: w.T),
-            "w_down": stack("layers.{i}.mlp.down_proj.weight", lambda w: w.T),
         },
         "out_norm": jnp.asarray(get("norm.weight"), dtype),
     }
+    if cfg.n_experts > 0:
+        # Mixtral MoE: block_sparse_moe.gate -> router, experts.N.{w1,w3,w2}
+        # -> gate/up/down stacked on a leading expert dim.
+        E = cfg.n_experts
+
+        def stack_experts(w_name: str, transform) -> jnp.ndarray:
+            # Convert expert-by-expert straight into the target dtype: a
+            # whole-tensor float32 numpy transient would be ~60 GB for
+            # mixtral-8x7b ([32,8,4096,14336] f32) on top of the resident
+            # state dict.
+            per_layer = []
+            for i in range(L):
+                per_layer.append(
+                    jnp.stack(
+                        [
+                            jnp.asarray(
+                                transform(
+                                    get(
+                                        f"layers.{i}.block_sparse_moe."
+                                        f"experts.{e}.{w_name}.weight"
+                                    )
+                                ),
+                                dtype,
+                            )
+                            for e in range(E)
+                        ]
+                    )
+                )
+            return jnp.stack(per_layer)
+
+        params["layers"].update(
+            {
+                "router": stack(
+                    "layers.{i}.block_sparse_moe.gate.weight", lambda w: w.T
+                ),
+                "w_gate": stack_experts("w1", lambda w: w.T),
+                "w_up": stack_experts("w3", lambda w: w.T),
+                "w_down": stack_experts("w2", lambda w: w.T),
+            }
+        )
+    else:
+        params["layers"].update(
+            {
+                "w_gate": stack("layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
+                "w_up": stack("layers.{i}.mlp.up_proj.weight", lambda w: w.T),
+                "w_down": stack("layers.{i}.mlp.down_proj.weight", lambda w: w.T),
+            }
+        )
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
     return params

@@ -54,6 +54,13 @@ class LlamaConfig:
     #             requires an ambient mesh (jax.sharding.use_mesh) with a
     #             "sequence" axis
     attn_impl: str = "xla"
+    # Mixture-of-experts (Mixtral family): n_experts == 0 means dense MLP.
+    # Routed top-k with GShard-style capacity dispatch; expert weights shard
+    # over the "expert" mesh axis (expert parallelism).
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
 
     @property
     def head_size(self) -> int:
@@ -85,24 +92,48 @@ CONFIGS: Dict[str, LlamaConfig] = {
         vocab_size=32000, dim=2048, n_layers=22, n_heads=32, n_kv_heads=4,
         hidden_dim=5632, max_seq_len=2048,
     ),
+    "tiny-moe": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=128, max_seq_len=128, norm_eps=1e-6, n_experts=4,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        hidden_dim=14336, rope_theta=1000000.0, max_seq_len=32768,
+        n_experts=8, n_experts_per_token=2,
+    ),
 }
 
 
 def param_logical_axes(cfg: LlamaConfig) -> Params:
     """Logical axis names for every param leaf (see parallel/sharding.py)."""
+    layers = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+    }
+    if cfg.n_experts > 0:
+        layers.update(
+            {
+                "router": ("layers", "embed", None),
+                "w_gate": ("layers", "expert", "embed", "mlp"),
+                "w_up": ("layers", "expert", "embed", "mlp"),
+                "w_down": ("layers", "expert", "mlp", "embed"),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": ("layers", "embed", "mlp"),
+                "w_up": ("layers", "embed", "mlp"),
+                "w_down": ("layers", "mlp", "embed"),
+            }
+        )
     axes = {
         "tok_embed": ("vocab", "embed"),
-        "layers": {
-            "attn_norm": ("layers", "embed"),
-            "wq": ("layers", "embed", "heads", "head_dim"),
-            "wk": ("layers", "embed", "kv_heads", "head_dim"),
-            "wv": ("layers", "embed", "kv_heads", "head_dim"),
-            "wo": ("layers", "heads", "head_dim", "embed"),
-            "mlp_norm": ("layers", "embed"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
-        },
+        "layers": layers,
         "out_norm": ("embed",),
     }
     if not cfg.tie_embeddings:
@@ -112,21 +143,22 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
 
 def quant_contracting(cfg: LlamaConfig) -> Params:
     """Contracting dims per leaf for ops.quant.quantize_params; () = dense."""
-    q = {
-        "tok_embed": (),
-        "layers": {
-            "attn_norm": (),
-            "wq": (1,),
-            "wk": (1,),
-            "wv": (1,),
-            "wo": (1, 2),
-            "mlp_norm": (),
-            "w_gate": (1,),
-            "w_up": (1,),
-            "w_down": (1,),
-        },
-        "out_norm": (),
+    moe = cfg.n_experts > 0
+    layers = {
+        "attn_norm": (),
+        "wq": (1,),
+        "wk": (1,),
+        "wv": (1,),
+        "wo": (1, 2),
+        "mlp_norm": (),
+        # Expert weights carry a leading expert dim; contracting shifts by 1.
+        "w_gate": (2,) if moe else (1,),
+        "w_up": (2,) if moe else (1,),
+        "w_down": (2,) if moe else (1,),
     }
+    if moe:
+        layers["router"] = ()
+    q = {"tok_embed": (), "layers": layers, "out_norm": ()}
     if not cfg.tie_embeddings:
         q["lm_head"] = (0,)
     return q
@@ -144,6 +176,20 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
         ).astype(cfg.dtype)
 
     L, D, H, KH, M = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.hidden_dim
+    E = cfg.n_experts
+    if E > 0:
+        mlp = {
+            "router": dense(next(k), (L, D, E), D),
+            "w_gate": dense(next(k), (L, E, D, M), D),
+            "w_up": dense(next(k), (L, E, D, M), D),
+            "w_down": dense(next(k), (L, E, M, D), M),
+        }
+    else:
+        mlp = {
+            "w_gate": dense(next(k), (L, D, M), D),
+            "w_up": dense(next(k), (L, D, M), D),
+            "w_down": dense(next(k), (L, M, D), M),
+        }
     params: Params = {
         "tok_embed": dense(next(k), (cfg.vocab_size, D), D),
         "layers": {
@@ -153,9 +199,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
             "wv": dense(next(k), (L, D, KH, hd), D),
             "wo": dense(next(k), (L, H, hd, D), H * hd),
             "mlp_norm": jnp.ones((L, D), cfg.dtype),
-            "w_gate": dense(next(k), (L, D, M), D),
-            "w_up": dense(next(k), (L, D, M), D),
-            "w_down": dense(next(k), (L, M, D), M),
+            **mlp,
         },
         "out_norm": jnp.ones((D,), cfg.dtype),
     }
@@ -209,6 +253,90 @@ def _self_attention(
     return dot_product_attention(q, k, v, causal=True, q_positions=positions)
 
 
+def _moe_ffn(
+    h: jnp.ndarray,  # [B, S, D] (post-norm)
+    lp: Params,
+    cfg: LlamaConfig,
+    train: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed top-k expert FFN (Mixtral-style).
+
+    Two execution strategies, same routing:
+
+    * train=True: GShard-style capacity dispatch — dense one-hot dispatch/
+      combine einsums keep shapes static, the expert dim shards over the
+      "expert" mesh axis (XLA inserts the expert-parallel all-to-alls), and
+      tokens beyond an expert's capacity drop (combine weight 0) — the
+      standard trade for static shapes at training batch sizes.
+    * train=False: exact dropless top-k — every expert computed for every
+      token, mixed by routing weights. E/k more FLOPs than dispatch, but
+      decode is HBM-bandwidth-bound (all expert weights stream from HBM
+      regardless of routing), and exactness makes prefill and cached decode
+      consistent — capacity dropping would make them diverge.
+
+    Returns (output [B,S,D], load-balancing aux scalar).
+    """
+    dt = cfg.dtype
+    b, s, d = h.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_token
+
+    logits = jnp.einsum(
+        "bsd,de->bse", h.astype(jnp.float32),
+        materialize(lp["router"], jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B,S,E]
+    top_w, top_idx = jax.lax.top_k(probs, k)  # [B,S,k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)  # Mixtral renorm
+
+    # Switch-style load-balancing aux: fraction of tokens routed to each
+    # expert (top-1 assignment) x mean router prob, scaled by E.
+    assigned = jax.nn.one_hot(top_idx[..., 0], E, dtype=jnp.float32)
+    aux = jnp.sum(
+        assigned.mean(axis=(0, 1)) * probs.mean(axis=(0, 1))
+    ) * E
+
+    if not train:
+        # Exact dropless mix: per-token expert weights [B,S,E].
+        w_full = jnp.sum(
+            jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+            * top_w[..., None],
+            axis=2,
+        )
+        gate = jnp.einsum("bsd,edm->bsem", h, materialize(lp["w_gate"], dt))
+        up = jnp.einsum("bsd,edm->bsem", h, materialize(lp["w_up"], dt))
+        out = jnp.einsum(
+            "bsem,emd->bsed", swiglu(gate, up), materialize(lp["w_down"], dt)
+        )
+        y = jnp.einsum("bsed,bse->bsd", out, w_full.astype(dt))
+        return y.astype(dt), aux
+
+    t = s * k
+    capacity = max(1, int(cfg.capacity_factor * s * k / E))
+    # Flatten (token, choice) pairs; compute each pair's slot within its
+    # expert's capacity buffer.
+    onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B,S,k,E]
+    flat = onehot.reshape(b, t, E)
+    pos = jnp.cumsum(flat, axis=1) - flat  # arrival order per expert
+    keep = (pos < capacity).astype(jnp.float32) * flat  # [B,T,E]
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32
+    )  # [B,T,E,C]
+    combine = dispatch * top_w.reshape(b, t)[..., None, None]
+
+    h_rep = jnp.repeat(h, k, axis=1)  # [B,T,D] (token order matches flatten)
+    expert_in = jnp.einsum(
+        "btec,btd->ebcd", dispatch.astype(dt), h_rep
+    )  # [E,B,C,D]
+    gate = jnp.einsum("ebcd,edm->ebcm", expert_in, materialize(lp["w_gate"], dt))
+    up = jnp.einsum("ebcd,edm->ebcm", expert_in, materialize(lp["w_up"], dt))
+    out = jnp.einsum(
+        "ebcm,emd->ebcd", swiglu(gate, up), materialize(lp["w_down"], dt)
+    )
+    y = jnp.einsum("ebcd,btec->btd", out, combine.astype(dt))  # [B,T,D]
+    y = y.reshape(b, s, k, d).sum(axis=2)
+    return y.astype(dt), aux
+
+
 def _lora_delta(
     h: jnp.ndarray, adapter, scale, out_einsum: str
 ) -> jnp.ndarray:
@@ -226,10 +354,12 @@ def _block(
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
     lora_layers: Optional[Params] = None,  # single-layer adapter tree
     lora_scale: float = 1.0,
-) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
-    """One transformer block. Returns (x_out, (k_entries, v_entries)) where
-    k/v entries are either the freshly computed seq entries (no cache: used
-    for training / prefill) or the updated full cache rows (decode)."""
+    train: bool = False,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+    """One transformer block. Returns (x_out, (k_entries, v_entries), aux)
+    where k/v entries are either the freshly computed seq entries (no cache:
+    training / prefill) or the updated full cache rows (decode), and aux is
+    the MoE load-balancing loss (0 for dense layers)."""
     dt = cfg.dtype
     lora = lora_layers or {}
 
@@ -268,10 +398,15 @@ def _block(
         o = o + _lora_delta(attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd")
     x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = proj("w_gate", h, "bsd,dm->bsm", "bsr,rm->bsm")
-    up = proj("w_up", h, "bsd,dm->bsm", "bsr,rm->bsm")
-    x = x + proj("w_down", swiglu(gate, up), "bsm,md->bsd", "bsr,rd->bsd")
-    return x, kv_out
+    if cfg.n_experts > 0:
+        y, aux = _moe_ffn(h, lp, cfg, train)
+        x = x + y
+    else:
+        gate = proj("w_gate", h, "bsd,dm->bsm", "bsr,rm->bsm")
+        up = proj("w_up", h, "bsd,dm->bsm", "bsr,rm->bsm")
+        x = x + proj("w_down", swiglu(gate, up), "bsm,md->bsd", "bsr,rd->bsd")
+        aux = jnp.zeros((), jnp.float32)
+    return x, kv_out, aux
 
 
 def forward(
@@ -285,6 +420,7 @@ def forward(
     # when slots <= position may hold stale data (e.g. resumed caches)
     lora: Optional[Params] = None,  # adapter tree from train.lora.init_lora
     remat: bool = False,  # rematerialize each block (training memory saver)
+    train: bool = False,  # MoE: capacity dispatch (train) vs exact (infer)
 ) -> Tuple[jnp.ndarray, Params]:
     """Returns (logits [B, S, vocab], kv).
 
@@ -303,7 +439,7 @@ def forward(
     lora_scale = lora["scale"] if lora is not None else 1.0
 
     def body(carry, layer_in):
-        x_out, kv = _block(
+        x_out, kv, aux = _block(
             carry,
             layer_in["lp"],
             positions,
@@ -312,8 +448,9 @@ def forward(
             kv_length,
             layer_in.get("lora"),
             lora_scale,
+            train,
         )
-        return x_out, kv
+        return x_out, {"kv": kv, "aux": aux}
 
     xs: Dict[str, Any] = {"lp": params["layers"]}
     if cache is not None:
@@ -322,7 +459,8 @@ def forward(
         xs["lora"] = lora["layers"]
     if remat:
         body = jax.checkpoint(body)
-    x, (ks, vs) = lax.scan(body, x, xs)
+    x, ys = lax.scan(body, x, xs)
+    ks, vs = ys["kv"]
 
     x = rms_norm(x, params["out_norm"], cfg.norm_eps)
     if cfg.tie_embeddings:
@@ -331,7 +469,13 @@ def forward(
         )
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, materialize(params["lm_head"], cfg.dtype))
-    return logits.astype(jnp.float32), {"k": ks, "v": vs}
+    kv = {"k": ks, "v": vs}
+    if cfg.n_experts > 0 and cache is None:
+        # Per-layer router load-balancing losses (training/prefill only —
+        # the decode cache must keep a stable {k, v} structure for buffer
+        # donation); the trainer adds router_aux_weight * mean.
+        kv["moe_aux"] = ys["aux"]
+    return logits.astype(jnp.float32), kv
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))

@@ -69,14 +69,34 @@ class Engine:
         cfg: LlamaConfig,
         params: Params,
         ec: EngineConfig = EngineConfig(),
+        mesh=None,
     ):
+        """mesh: optional jax Mesh for sharded serving. Params are laid out
+        by parallel.sharding.SERVE_RULES (tensor-parallel heads/mlp/vocab,
+        data-parallel batch); the KV cache shards the same way, so decode
+        collectives ride ICI. Constraint: the tensor axis must divide
+        n_kv_heads (llama2-70b: KH=8 => tensor<=8 per data replica)."""
         self.cfg, self.params, self.ec = cfg, params, ec
         # A prefill fragment must fit in the cache; clamp so no request can
         # ever produce an insert larger than a slot.
         ec.max_prefill_len = min(ec.max_prefill_len, ec.max_seq_len)
         B, S = ec.max_batch, ec.max_seq_len
 
-        self.cache = llama.init_cache(cfg, B, S)
+        self.mesh = mesh
+        if mesh is not None:
+            from substratus_tpu.parallel.sharding import SERVE_RULES, shard_tree
+
+            self.params = shard_tree(
+                params, mesh, llama.param_logical_axes(cfg), SERVE_RULES
+            )
+            self.cache = shard_tree(
+                llama.init_cache(cfg, B, S),
+                mesh,
+                llama.cache_logical_axes(cfg),
+                SERVE_RULES,
+            )
+        else:
+            self.cache = llama.init_cache(cfg, B, S)
         self.tokens = jnp.zeros((B,), jnp.int32)
         self.positions = jnp.zeros((B,), jnp.int32)
         self.temps = jnp.zeros((B,), jnp.float32)

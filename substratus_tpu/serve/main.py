@@ -21,6 +21,8 @@ from typing import Any, Dict
 
 import jax
 
+from substratus_tpu.parallel.distributed import maybe_initialize
+
 
 def load_params_json(path: str = "/content/params.json") -> Dict[str, Any]:
     if os.path.exists(path):
@@ -39,6 +41,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument("--quantize", default=None, choices=["int8", "none"])
     args = ap.parse_args(argv)
+
+    # Multi-host slice: join the jax.distributed world the operator wired
+    # (no-op on single hosts).
+    maybe_initialize()
 
     params_json = load_params_json()
     model_dir = args.model or params_json.get("model") or (
@@ -94,7 +100,21 @@ def main(argv=None) -> int:
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
         eos_token_id=tokenizer.eos_id if tokenizer.eos_id is not None else 2,
     )
-    engine = Engine(cfg, params, ec)
+    # Multi-chip serving: tensor-parallel over as many chips as the kv heads
+    # allow (params.json {"tensor": N} overrides), data-parallel the rest.
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1:
+        from substratus_tpu.parallel.mesh import build_mesh
+
+        tp = int(params_json.get("tensor", 0)) or min(n_dev, cfg.n_kv_heads)
+        while n_dev % tp or cfg.n_kv_heads % tp:
+            tp -= 1
+        mesh = build_mesh(data=n_dev // tp, tensor=tp)
+        if max_batch % (n_dev // tp):
+            ec.max_batch = ((max_batch // (n_dev // tp)) + 1) * (n_dev // tp)
+        print(f"serving mesh: data={n_dev // tp} tensor={tp}", flush=True)
+    engine = Engine(cfg, params, ec, mesh=mesh)
     engine.start()
     state = ServerState(engine, tokenizer, model_name)
     print(f"serving {model_name} on {args.host}:{args.port}", flush=True)

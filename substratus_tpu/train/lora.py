@@ -48,6 +48,15 @@ def init_lora(
         "w_gate": cfg.dim, "w_up": cfg.dim,
         "w_down": cfg.hidden_dim,
     }
+    if cfg.n_experts > 0:
+        moe_mlp = {"w_gate", "w_up", "w_down"} & set(targets)
+        if moe_mlp:
+            raise ValueError(
+                f"LoRA targets {sorted(moe_mlp)} are expert-routed on MoE "
+                f"models (n_experts={cfg.n_experts}); adapters for expert "
+                "weights are not supported yet — target attention "
+                "projections (wq/wk/wv/wo) instead"
+            )
     keys = jax.random.split(key, len(targets))
     layers: Dict[str, Any] = {}
     for k, name in zip(keys, targets):

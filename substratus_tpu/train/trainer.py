@@ -183,16 +183,20 @@ class Trainer:
                 lora = {"layers": trainable, "scale": lora_scale}
             else:
                 params, lora = trainable, None
-            logits, _ = llama.forward(
+            logits, kv = llama.forward(
                 params,
                 batch["tokens"],
                 cfg,
                 lora=lora,
                 remat=tc.remat,
+                train=True,
             )
-            return cross_entropy_loss(
+            loss = cross_entropy_loss(
                 logits[:, :-1], batch["tokens"][:, 1:], batch["weights"][:, 1:]
             )
+            if "moe_aux" in kv:  # router load balancing (MoE models)
+                loss = loss + cfg.router_aux_weight * kv["moe_aux"].mean()
+            return loss
 
         def train_step(trainable, frozen_params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(

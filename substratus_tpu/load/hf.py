@@ -41,6 +41,7 @@ def config_from_hf(hf_cfg: Any) -> LlamaConfig:
         # Mixtral MoE fields
         n_experts=get("num_local_experts", 0) or 0,
         n_experts_per_token=get("num_experts_per_tok", 2) or 2,
+        router_aux_weight=get("router_aux_loss_coef", 0.01) or 0.01,
     )
 
 

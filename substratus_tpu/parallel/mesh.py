@@ -26,9 +26,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Order matters: outer-to-inner. data outermost so multi-slice DCN traffic is
-# confined to gradient/all-reduce on the data axis.
-MESH_AXES = ("data", "fsdp", "sequence", "tensor", "expert")
+# Order matters: outer-to-inner. data/stage outermost so multi-slice DCN
+# traffic is confined to data-parallel gradient all-reduce and pipeline
+# stage-boundary transfers (both DCN-friendly: large, infrequent).
+MESH_AXES = ("data", "stage", "fsdp", "sequence", "tensor", "expert")
 
 
 def build_mesh(
@@ -37,6 +38,7 @@ def build_mesh(
     sequence: int = 1,
     tensor: int = 1,
     expert: int = 1,
+    stage: int = 1,
     *,
     dcn_data: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -52,7 +54,7 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    sizes = [data, fsdp, sequence, tensor, expert]
+    sizes = [data, stage, fsdp, sequence, tensor, expert]
     if sizes.count(-1) > 1:
         raise ValueError("at most one mesh axis may be -1")
     if -1 in sizes:

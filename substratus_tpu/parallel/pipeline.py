@@ -1,0 +1,135 @@
+"""GPipe-style pipeline parallelism over the "stage" mesh axis.
+
+Greenfield (SURVEY.md §2.3 — the reference has no parallelism at all). The
+transformer's layer stack is split into `n_stages` contiguous groups, one
+per device along the "stage" axis; activations flow stage-to-stage via
+`jax.lax.ppermute` (XLA lowers to neighbor transfers — ICI within a slice,
+DCN across slices, which is why "stage" sits next to "data" in MESH_AXES).
+
+Schedule: classic GPipe. M microbatches enter stage 0 one step apart; step t
+has stage s working on microbatch t-s; after M + S - 1 steps every
+microbatch has exited the last stage. The bubble fraction is (S-1)/(M+S-1) —
+callers pick M >= 4*S to amortize. Backward is jax.grad through the same
+scan (ppermute is differentiable), i.e. GPipe's synchronous fill-drain, not
+1F1B — a later round can swap the schedule without touching callers.
+
+Embedding and the LM head are replicated and run outside the pipelined
+region (they are a tiny fraction of FLOPs); only the block stack pipelines.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from substratus_tpu.models import llama
+from substratus_tpu.models.llama import LlamaConfig, Params
+from substratus_tpu.ops.basics import rms_norm
+from substratus_tpu.ops.quant import materialize
+
+AXIS = "stage"
+
+
+def stage_params(params: Params, n_stages: int) -> Params:
+    """Reshape stacked layers [L, ...] -> [n_stages, L/S, ...]; embed/norm/
+    head stay replicated."""
+    L = jax.tree.leaves(params["layers"])[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible into {n_stages} stages")
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda x: x.reshape((n_stages, L // n_stages) + x.shape[1:]),
+        params["layers"],
+    )
+    return out
+
+
+def _stage_fn(local_layers: Params, x: jnp.ndarray, positions, cfg) -> jnp.ndarray:
+    """Apply this stage's local layer stack (scan over layers)."""
+
+    def body(carry, lp):
+        x_out, _, _ = llama._block(carry, lp, positions, cfg, None)
+        return x_out, None
+
+    x, _ = lax.scan(body, x, local_layers)
+    return x
+
+
+def pipeline_forward(
+    params: Params,  # stage_params() output, "layers" sharded on stage
+    tokens: jnp.ndarray,  # [B, S]
+    cfg: LlamaConfig,
+    n_stages: int,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Pipelined logits [B, S, vocab]. Call inside jit with an ambient mesh
+    (jax.set_mesh) that has a "stage" axis of size n_stages."""
+    B, S = tokens.shape
+    if cfg.n_experts > 0:
+        # The stage fn would silently drop the router aux loss and use the
+        # inference expert path; refuse rather than mis-train.
+        raise NotImplementedError(
+            "pipeline parallelism for MoE models is not implemented yet "
+            "(router aux loss must thread through the pipelined region)"
+        )
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    mb = B // n_microbatches
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
+
+    x = materialize(params["tok_embed"], cfg.dtype)[tokens]
+    micro = x.reshape(n_microbatches, mb, S, cfg.dim)
+
+    layers_spec = P(AXIS)  # leading stage dim sharded; rest replicated
+
+    def pipelined(layers_local, micro):
+        # layers_local leaves: [1, L/S, ...] (this stage's group).
+        local = jax.tree.map(lambda a: a[0], layers_local)
+        stage = lax.axis_index(AXIS)
+        n = n_stages
+        M = n_microbatches
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(carry, t):
+            act = carry  # activation arriving from the previous stage
+            inject = micro[jnp.clip(t, 0, M - 1)]
+            inp = jnp.where(stage == 0, inject, act)
+            out = _stage_fn(local, inp, positions, cfg)
+            # The last stage's output at step t is microbatch t-(n-1).
+            collect = jnp.where(stage == n - 1, out, jnp.zeros_like(out))
+            act_next = lax.ppermute(out, AXIS, perm)
+            return act_next, collect
+
+        init = jnp.zeros((mb, S, cfg.dim), cfg.dtype)
+        # Mark the carry as stage-varying: the scan's output (post-ppermute)
+        # is device-varying, and scan requires carry types to match.
+        init = lax.pcast(init, (AXIS,), to="varying")
+        _, collected = lax.scan(step, init, jnp.arange(M + n - 1))
+        # Valid outputs live at steps n-1 .. n-1+M-1; broadcast them off the
+        # last stage to every stage (zeros elsewhere -> psum is a select).
+        outs = collected[n - 1:]
+        outs = lax.psum(outs, AXIS)
+        return outs  # [M, mb, S, D]
+
+    outs = jax.shard_map(
+        pipelined,
+        in_specs=(layers_spec, P()),
+        out_specs=P(),
+        axis_names={AXIS},
+    )(params["layers"], micro)
+
+    x = outs.reshape(B, S, cfg.dim)
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, materialize(params["tok_embed"], cfg.dtype)
+        )
+    else:
+        logits = jnp.einsum(
+            "bsd,dv->bsv", x, materialize(params["lm_head"], cfg.dtype)
+        )
+    return logits.astype(jnp.float32)

@@ -152,8 +152,7 @@ def cmd_apply(args) -> int:
     return 0
 
 
-def cmd_get(args) -> int:
-    client = _client(args)
+def _render_table(client, args) -> None:
     kinds = [_norm_kind(args.kind)] if args.kind else list(KINDS)
     rows = []
     for kind in kinds:
@@ -172,12 +171,27 @@ def cmd_get(args) -> int:
             )
     if not rows:
         print("no resources found")
-        return 0
+        return
     widths = [max(len(r[i]) for r in rows + [("KIND", "NAME", "READY", "STATUS")]) for i in range(4)]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
     print(fmt.format("KIND", "NAME", "READY", "STATUS"))
     for r in rows:
         print(fmt.format(*r))
+
+
+def cmd_get(args) -> int:
+    client = _client(args)
+    if getattr(args, "watch", False):
+        # Live status view (the reference's TUI readiness panel, terminal
+        # rendition): redraw on an interval, reusing one client.
+        try:
+            while True:
+                print("\033[2J\033[H", end="")
+                _render_table(client, args)
+                time.sleep(2)
+        except KeyboardInterrupt:
+            return 0
+    _render_table(client, args)
     return 0
 
 
@@ -343,6 +357,7 @@ def register(sub) -> None:
     p = sub.add_parser("get", help="list substratus objects")
     p.add_argument("kind", nargs="?")
     p.add_argument("name", nargs="?")
+    p.add_argument("-w", "--watch", action="store_true", help="live refresh")
     common(p)
     p.set_defaults(func=cmd_get)
 

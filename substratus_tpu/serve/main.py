@@ -42,6 +42,10 @@ def main(argv=None) -> int:
     ap.add_argument("--quantize", default=None, choices=["int8", "none"])
     args = ap.parse_args(argv)
 
+    from substratus_tpu.utils.jaxenv import honor_requested_platform
+
+    honor_requested_platform()
+
     # Multi-host slice: join the jax.distributed world the operator wired
     # (no-op on single hosts).
     maybe_initialize()

@@ -69,6 +69,67 @@ def build_app(state: ServerState) -> web.Application:
             return web.Response(status=500, text=str(state.engine.error))
         return web.Response(status=200 if state.ready else 503, text="ok")
 
+    profile_lock = asyncio.Lock()
+
+    @routes.post("/debug/profile")
+    async def profile(request: web.Request) -> web.Response:
+        """Capture a JAX/XLA device trace while serving traffic (SURVEY.md
+        §5: the reference had no profiling story; here it is an endpoint).
+        Body: {"seconds": N (0 < N <= 60)}. Traces land in TensorBoard
+        format under a fixed base dir (PROFILE_DIR env overrides) — the
+        path is never caller-controlled."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        if not isinstance(body, dict):
+            raise web.HTTPBadRequest(text="body must be a JSON object")
+        try:
+            seconds = float(body.get("seconds", 3))
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="'seconds' must be a number")
+        if not (0 < seconds <= 60):
+            raise web.HTTPBadRequest(text="'seconds' must be in (0, 60]")
+
+        import os
+
+        base = os.environ.get("PROFILE_DIR", "/tmp/substratus-profile")
+        out_dir = os.path.join(base, time.strftime("%Y%m%d-%H%M%S"))
+
+        if profile_lock.locked():
+            raise web.HTTPConflict(text="a profile capture is already running")
+        async with profile_lock:
+            import jax
+
+            loop = asyncio.get_running_loop()
+
+            def capture():
+                with jax.profiler.trace(out_dir):
+                    time.sleep(seconds)
+
+            await loop.run_in_executor(None, capture)
+        files = []
+        for root, _, names in os.walk(out_dir):
+            files.extend(os.path.join(root, n) for n in names)
+        return web.json_response(
+            {"dir": out_dir, "seconds": seconds, "files": sorted(files)[-10:]}
+        )
+
+    @routes.get("/metrics")
+    async def metrics(request: web.Request) -> web.Response:
+        """Prometheus-format serving metrics."""
+        eng = state.engine
+        active = int(eng.active.sum())
+        lines = [
+            f"substratus_serve_active_slots {active}",
+            f"substratus_serve_max_slots {eng.ec.max_batch}",
+            f"substratus_serve_queue_depth {eng.queue.qsize()}",
+        ]
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/plain",
+        )
+
     @routes.get("/v1/models")
     async def models(request: web.Request) -> web.Response:
         return web.json_response(

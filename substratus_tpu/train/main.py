@@ -33,6 +33,10 @@ def main(argv=None) -> int:
     ap.add_argument("--params", default="/content/params.json")
     args = ap.parse_args(argv)
 
+    from substratus_tpu.utils.jaxenv import honor_requested_platform
+
+    honor_requested_platform()
+
     p = {}
     if os.path.exists(args.params):
         with open(args.params) as f:
@@ -145,12 +149,35 @@ def main(argv=None) -> int:
         trainer.opt_state = state["opt_state"]
         print(f"resumed from step {start_step}", flush=True)
 
+    # Profiling window (SURVEY.md §5): params.json {"profile_steps": [a, b]}
+    # captures a device trace of steps a..b into {out}/profile. The window
+    # is clamped to the steps this run will actually execute (resume can
+    # skip past it) and the trace always stops/flushes.
+    prof_range = None
+    prof = p.get("profile_steps")
+    if prof and len(list(prof)) == 2:
+        a, b = (int(x) for x in prof)
+        a, b = max(a, start_step), min(b, steps - 1)
+        if a <= b:
+            prof_range = (a, b)
+    elif prof:
+        print(f"ignoring malformed profile_steps {prof!r} (need [start, end])")
+
+    tracing = False
     t0 = time.time()
     for step in range(start_step, steps):
+        if prof_range and step == prof_range[0]:
+            jax.profiler.start_trace(os.path.join(args.out, "profile"))
+            tracing = True
         loss = trainer.train_step(next(data))
+        if tracing and step == prof_range[1]:
+            jax.profiler.stop_trace()
+            tracing = False
         if step % 10 == 0 or step == steps - 1:
             dt = time.time() - t0
             print(f"step {step} loss {loss:.4f} ({dt:.1f}s)", flush=True)
+    if tracing:
+        jax.profiler.stop_trace()
         trainable = trainer.lora if trainer.lora is not None else trainer.params
         ckpt.maybe_save(
             step + 1,

@@ -1,0 +1,31 @@
+"""JAX backend selection hardening for container entrypoints.
+
+Some environments inject out-of-tree PJRT plugins via sitecustomize that
+intercept backend initialization even when `JAX_PLATFORMS=cpu` is set; if
+the plugin's device tunnel is unreachable, every jax call hangs. Entrypoints
+call `honor_requested_platform()` first: when the operator/user explicitly
+asked for cpu (or tpu), any other registered plugin backend is dropped so
+the request is actually honored — a hung accelerator tunnel must fail over
+loudly, not hang a serving pod's readiness forever.
+"""
+from __future__ import annotations
+
+import os
+
+_KNOWN = {"cpu", "tpu", "gpu", "cuda", "rocm"}
+
+
+def honor_requested_platform() -> None:
+    requested = os.environ.get("JAX_PLATFORMS", "")
+    if not requested:
+        return
+    wanted = {p.strip() for p in requested.split(",") if p.strip()}
+    if not wanted or not wanted.issubset(_KNOWN):
+        return  # a plugin platform was requested explicitly; leave it alone
+    import jax
+    from jax._src import xla_bridge as xb
+
+    for name in list(xb._backend_factories):
+        if name not in wanted and name not in _KNOWN:
+            xb._backend_factories.pop(name, None)
+    jax.config.update("jax_platforms", ",".join(sorted(wanted)))

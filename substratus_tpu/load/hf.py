@@ -146,6 +146,77 @@ def convert_llama_state_dict(
     return params
 
 
+def config_from_hf_opt(hf_cfg: Any):
+    from substratus_tpu.models.opt import OPTConfig
+
+    # Architecture variants models/opt.py does not implement; fail loudly
+    # rather than convert to silently-wrong logits (opt-350m is post-LN with
+    # a projected embedding dim).
+    if not getattr(hf_cfg, "do_layer_norm_before", True):
+        raise NotImplementedError(
+            "post-LN OPT variants (do_layer_norm_before=false, e.g. "
+            "opt-350m) are not supported"
+        )
+    proj = getattr(hf_cfg, "word_embed_proj_dim", hf_cfg.hidden_size)
+    if proj != hf_cfg.hidden_size:
+        raise NotImplementedError(
+            f"OPT word_embed_proj_dim={proj} != hidden_size="
+            f"{hf_cfg.hidden_size} (embedding projection) is not supported"
+        )
+    return OPTConfig(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        hidden_dim=hf_cfg.ffn_dim,
+        max_seq_len=hf_cfg.max_position_embeddings,
+    )
+
+
+def convert_opt_state_dict(sd: Mapping[str, Any], cfg, dtype=jnp.bfloat16) -> Params:
+    """HF OPTForCausalLM state dict -> models/opt.py params. Note HF's
+    per-layer `final_layer_norm` is the pre-FFN norm (ln2 here); the
+    top-level decoder final_layer_norm is the real final norm."""
+    hd = cfg.head_size
+    L, D, H = cfg.n_layers, cfg.dim, cfg.n_heads
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("model.decoder.", "decoder.", ""):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    def stack(fmt: str, transform) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([transform(get(fmt.format(i=i))) for i in range(L)]), dtype
+        )
+
+    return {
+        "tok_embed": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "pos_embed": jnp.asarray(get("embed_positions.weight"), dtype),
+        "layers": {
+            "ln1_scale": stack("layers.{i}.self_attn_layer_norm.weight", lambda w: w),
+            "ln1_bias": stack("layers.{i}.self_attn_layer_norm.bias", lambda w: w),
+            "wq": stack("layers.{i}.self_attn.q_proj.weight", lambda w: w.T.reshape(D, H, hd)),
+            "bq": stack("layers.{i}.self_attn.q_proj.bias", lambda w: w.reshape(H, hd)),
+            "wk": stack("layers.{i}.self_attn.k_proj.weight", lambda w: w.T.reshape(D, H, hd)),
+            "bk": stack("layers.{i}.self_attn.k_proj.bias", lambda w: w.reshape(H, hd)),
+            "wv": stack("layers.{i}.self_attn.v_proj.weight", lambda w: w.T.reshape(D, H, hd)),
+            "bv": stack("layers.{i}.self_attn.v_proj.bias", lambda w: w.reshape(H, hd)),
+            "wo": stack("layers.{i}.self_attn.out_proj.weight", lambda w: w.T.reshape(H, hd, D)),
+            "bo": stack("layers.{i}.self_attn.out_proj.bias", lambda w: w),
+            "ln2_scale": stack("layers.{i}.final_layer_norm.weight", lambda w: w),
+            "ln2_bias": stack("layers.{i}.final_layer_norm.bias", lambda w: w),
+            "fc1": stack("layers.{i}.fc1.weight", lambda w: w.T),
+            "fc1_b": stack("layers.{i}.fc1.bias", lambda w: w),
+            "fc2": stack("layers.{i}.fc2.weight", lambda w: w.T),
+            "fc2_b": stack("layers.{i}.fc2.bias", lambda w: w),
+        },
+        "final_ln_scale": jnp.asarray(get("final_layer_norm.weight"), dtype),
+        "final_ln_bias": jnp.asarray(get("final_layer_norm.bias"), dtype),
+    }
+
+
 def load_pretrained(
     path_or_name: str, dtype=jnp.bfloat16
 ) -> Tuple[LlamaConfig, Params]:
@@ -158,7 +229,14 @@ def load_pretrained(
             raw = json.load(f)
         from types import SimpleNamespace
 
-        cfg = config_from_hf(SimpleNamespace(**raw))
+        hf_ns = SimpleNamespace(**raw)
+        model_type = raw.get("model_type", "llama")
+        if model_type == "opt":
+            cfg = config_from_hf_opt(hf_ns)
+            convert = convert_opt_state_dict
+        else:  # llama / mistral / mixtral families
+            cfg = config_from_hf(hf_ns)
+            convert = convert_llama_state_dict
         sd: Dict[str, np.ndarray] = {}
         st_files = [
             f for f in os.listdir(path_or_name) if f.endswith(".safetensors")
@@ -186,13 +264,17 @@ def load_pretrained(
                             weights_only=True,
                         )
                     )
-        return cfg, convert_llama_state_dict(sd, cfg, dtype)
+        return cfg, convert(sd, cfg, dtype)
 
     # Fall back to transformers hub loading (requires network or cache).
     from transformers import AutoConfig, AutoModelForCausalLM
 
     hf_cfg = AutoConfig.from_pretrained(path_or_name)
-    cfg = config_from_hf(hf_cfg)
     model = AutoModelForCausalLM.from_pretrained(path_or_name)
-    params = convert_llama_state_dict(model.state_dict(), cfg, dtype)
+    if getattr(hf_cfg, "model_type", "llama") == "opt":
+        cfg = config_from_hf_opt(hf_cfg)
+        params = convert_opt_state_dict(model.state_dict(), cfg, dtype)
+    else:
+        cfg = config_from_hf(hf_cfg)
+        params = convert_llama_state_dict(model.state_dict(), cfg, dtype)
     return cfg, params

@@ -46,19 +46,25 @@ def main(argv=None) -> int:
         cfg, params = load_pretrained(name)
         meta = {"source": name}
     else:
-        # Weightless smoke import (reference parallel: opt-125m CPU smoke).
+        # Weightless smoke import (reference parallel: opt-125m CPU smoke);
+        # config names resolve across every registered family.
+        from substratus_tpu.models import registry
+
         cfg_name = p.get("config", "tiny")
-        cfg = llama.CONFIGS[cfg_name]
-        params = llama.init_params(cfg, jax.random.key(int(p.get("seed", 0))))
+        family, cfg = registry.find_named_config(cfg_name)
+        params = family.init_params(cfg, jax.random.key(int(p.get("seed", 0))))
         meta = {"source": f"random:{cfg_name}"}
 
     if p.get("quantize") == "int8":
-        from substratus_tpu.ops.quant import quantize_params
+        if isinstance(cfg, llama.LlamaConfig):
+            from substratus_tpu.ops.quant import quantize_params
 
-        params = jax.jit(
-            lambda x: quantize_params(x, llama.quant_contracting(cfg))
-        )(params)
-        meta["quantize"] = "int8"
+            params = jax.jit(
+                lambda x: quantize_params(x, llama.quant_contracting(cfg))
+            )(params)
+            meta["quantize"] = "int8"
+        else:
+            print("int8 quantization not supported for this family; skipping")
 
     save_artifact(args.out, params, cfg, extra_meta=meta)
 

@@ -1,0 +1,63 @@
+"""Model-family registry: the single dispatch point for multi-architecture
+support.
+
+Every family is a module implementing the engine/trainer protocol
+(CONFIGS / init_params / param_logical_axes / init_cache /
+cache_logical_axes / forward / decode_step). Adding a family means one
+entry here; serve/load/checkpoint code looks up, never type-switches.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from substratus_tpu.models import llama, opt
+
+FAMILIES = {
+    "llama": llama,  # Llama 2/3, Mistral, Mixtral (MoE), TinyLlama
+    "opt": opt,  # facebook/opt-*
+}
+
+# transformers `model_type` -> family name (HF checkpoint dispatch).
+HF_MODEL_TYPES = {
+    "llama": "llama",
+    "mistral": "llama",
+    "mixtral": "llama",
+    "opt": "opt",
+}
+
+_CONFIG_CLASS_TO_FAMILY = {
+    llama.LlamaConfig: "llama",
+    opt.OPTConfig: "opt",
+}
+
+
+def family_of(cfg: Any) -> str:
+    for cls, name in _CONFIG_CLASS_TO_FAMILY.items():
+        if isinstance(cfg, cls):
+            return name
+    raise TypeError(f"unknown model config type {type(cfg)!r}")
+
+
+def module_of(cfg: Any):
+    return FAMILIES[family_of(cfg)]
+
+
+def config_class(name: str):
+    return {v: k for k, v in _CONFIG_CLASS_TO_FAMILY.items()}[name]
+
+
+def module_for(name: str):
+    if name not in FAMILIES:
+        raise KeyError(f"unknown model family {name!r} (known: {sorted(FAMILIES)})")
+    return FAMILIES[name]
+
+
+def find_named_config(name: str) -> Tuple[Any, Any]:
+    """Named smoke/test config -> (family_module, config)."""
+    for fam in FAMILIES.values():
+        if name in fam.CONFIGS:
+            return fam, fam.CONFIGS[name]
+    known = sorted(
+        cfg for fam in FAMILIES.values() for cfg in fam.CONFIGS
+    )
+    raise KeyError(f"unknown model config {name!r} (known: {known})")

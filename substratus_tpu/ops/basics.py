@@ -48,3 +48,18 @@ def rope(
 
 def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.silu(gate) * up
+
+
+def layer_norm(
+    x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-5
+) -> jnp.ndarray:
+    """Classic LayerNorm (mean-centered, affine) in float32 accumulation —
+    the GPT/OPT-family normalizer (Llama uses rms_norm)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (
+        normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    ).astype(dtype)

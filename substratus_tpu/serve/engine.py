@@ -70,15 +70,22 @@ class Engine:
         params: Params,
         ec: EngineConfig = EngineConfig(),
         mesh=None,
+        model=llama,
     ):
-        """mesh: optional jax Mesh for sharded serving. Params are laid out
+        """model: the model-family module (models.llama, models.opt, ...)
+        implementing forward/init_cache/param_logical_axes/cache_logical_axes.
+
+        mesh: optional jax Mesh for sharded serving. Params are laid out
         by parallel.sharding.SERVE_RULES (tensor-parallel heads/mlp/vocab,
         data-parallel batch); the KV cache shards the same way, so decode
         collectives ride ICI. Constraint: the tensor axis must divide
         n_kv_heads (llama2-70b: KH=8 => tensor<=8 per data replica)."""
         self.cfg, self.params, self.ec = cfg, params, ec
-        # A prefill fragment must fit in the cache; clamp so no request can
-        # ever produce an insert larger than a slot.
+        self.model = model
+        # The cache may never outrun the model's position space (learned
+        # position embeddings silently clamp on OOB lookups), and a prefill
+        # fragment must fit in the cache.
+        ec.max_seq_len = min(ec.max_seq_len, cfg.max_seq_len)
         ec.max_prefill_len = min(ec.max_prefill_len, ec.max_seq_len)
         B, S = ec.max_batch, ec.max_seq_len
 
@@ -87,16 +94,16 @@ class Engine:
             from substratus_tpu.parallel.sharding import SERVE_RULES, shard_tree
 
             self.params = shard_tree(
-                params, mesh, llama.param_logical_axes(cfg), SERVE_RULES
+                params, mesh, model.param_logical_axes(cfg), SERVE_RULES
             )
             self.cache = shard_tree(
-                llama.init_cache(cfg, B, S),
+                model.init_cache(cfg, B, S),
                 mesh,
-                llama.cache_logical_axes(cfg),
+                model.cache_logical_axes(cfg),
                 SERVE_RULES,
             )
         else:
-            self.cache = llama.init_cache(cfg, B, S)
+            self.cache = model.init_cache(cfg, B, S)
         self.tokens = jnp.zeros((B,), jnp.int32)
         self.positions = jnp.zeros((B,), jnp.int32)
         self.temps = jnp.zeros((B,), jnp.float32)
@@ -118,19 +125,19 @@ class Engine:
         self._admitting: Optional[Request] = None
 
         self._decode_fn = self._build_decode()
-        self._prefill_fn = partial(self._prefill_jit, self.cfg)
+        self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
         self._insert_fn = self._build_insert()
 
     # --- jitted device functions -----------------------------------------
 
     @staticmethod
-    @partial(jax.jit, static_argnums=(0,))
-    def _prefill_jit(cfg, params, tokens, true_len):
+    @partial(jax.jit, static_argnums=(0, 1))
+    def _prefill_jit(model, cfg, params, tokens, true_len):
         """tokens [1, Sbucket] (right-padded); returns kv fragment + last
         real token's logits."""
         s = tokens.shape[1]
         positions = jnp.arange(s, dtype=jnp.int32)[None, :]
-        logits, kv = llama.forward(params, tokens, cfg, positions=positions)
+        logits, kv = model.forward(params, tokens, cfg, positions=positions)
         last = logits[0, true_len - 1]
         return last, kv
 
@@ -152,11 +159,11 @@ class Engine:
         return insert
 
     def _build_decode(self):
-        cfg, ec = self.cfg, self.ec
+        cfg, ec, model = self.cfg, self.ec, self.model
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, tokens, positions, temps, top_ps, key):
-            logits, cache = llama.forward(
+            logits, cache = model.forward(
                 params,
                 tokens[:, None],
                 cfg,

@@ -58,7 +58,7 @@ def main(argv=None) -> int:
     max_batch = args.max_batch or int(params_json.get("max_batch", 8))
     max_seq_len = args.max_seq_len or int(params_json.get("max_seq_len", 1024))
 
-    from substratus_tpu.models import llama
+    from substratus_tpu.models import llama, registry
     from substratus_tpu.serve.engine import Engine, EngineConfig
     from substratus_tpu.serve.server import ServerState, serve_forever
     from substratus_tpu.serve.tokenizer import load_tokenizer
@@ -77,27 +77,34 @@ def main(argv=None) -> int:
         tokenizer = load_tokenizer(model_dir)
     else:
         # Weightless smoke mode (reference parallel: the opt-125m CPU smoke
-        # in test/system.sh) — random init of a named config.
+        # in test/system.sh) — random init of a named config from any
+        # registered family.
         name = args.config or params_json.get("config", "tiny")
-        cfg = llama.CONFIGS[name]
+        smoke_family, cfg = registry.find_named_config(name)
         tokenizer = load_tokenizer(None)
         if cfg.vocab_size < tokenizer.vocab_size:
             cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
-        params = llama.init_params(cfg, jax.random.key(0))
+        params = smoke_family.init_params(cfg, jax.random.key(0))
         model_name = name
 
+    family = registry.module_of(cfg)
+
     if quantize == "int8":
-        from substratus_tpu.ops.quant import is_quantized, quantize_params
+        if family is llama:
+            from substratus_tpu.ops.quant import is_quantized, quantize_params
 
-        if not is_quantized(params):  # int8 artifacts arrive pre-quantized
-            params = jax.jit(
-                lambda p: quantize_params(p, llama.quant_contracting(cfg))
-            )(params)
+            if not is_quantized(params):  # int8 artifacts are pre-quantized
+                params = jax.jit(
+                    lambda p: quantize_params(p, llama.quant_contracting(cfg))
+                )(params)
+        else:
+            print("int8 quantization not supported for this family; skipping")
 
-    # Serving picks its own attention impl (never inherited from training):
-    # XLA reference by default; params.json {"attn_impl": "flash"} opts a
-    # TPU server into the Pallas prefill kernel.
-    cfg = cfg.replace(attn_impl=params_json.get("attn_impl", "xla"))
+    if family is llama:
+        # Serving picks its own attention impl (never inherited from
+        # training): XLA reference by default; params.json
+        # {"attn_impl": "flash"} opts a TPU server into the Pallas kernel.
+        cfg = cfg.replace(attn_impl=params_json.get("attn_impl", "xla"))
 
     ec = EngineConfig(
         max_batch=max_batch,
@@ -118,7 +125,7 @@ def main(argv=None) -> int:
         if max_batch % (n_dev // tp):
             ec.max_batch = ((max_batch // (n_dev // tp)) + 1) * (n_dev // tp)
         print(f"serving mesh: data={n_dev // tp} tensor={tp}", flush=True)
-    engine = Engine(cfg, params, ec, mesh=mesh)
+    engine = Engine(cfg, params, ec, mesh=mesh, model=family)
     engine.start()
     state = ServerState(engine, tokenizer, model_name)
     print(f"serving {model_name} on {args.host}:{args.port}", flush=True)

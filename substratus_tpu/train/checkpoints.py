@@ -37,18 +37,32 @@ def _cfg_to_dict(cfg: LlamaConfig) -> Dict[str, Any]:
     return d
 
 
-def _cfg_from_dict(d: Dict[str, Any]) -> LlamaConfig:
+def _cfg_from_dict(d: Dict[str, Any], family: str = "llama"):
     import jax.numpy as jnp
+
+    from substratus_tpu.models import registry
 
     d = dict(d)
     d["dtype"] = jnp.dtype(d.get("dtype", "bfloat16"))
-    return LlamaConfig(**d)
+    return registry.config_class(family)(**d)
+
+
+def _family_of(cfg) -> str:
+    from substratus_tpu.models import registry
+
+    return registry.family_of(cfg)
+
+
+def _family_module(name: str):
+    from substratus_tpu.models import registry
+
+    return registry.module_for(name)
 
 
 def save_artifact(
     path: str,
     params: Params,
-    cfg: LlamaConfig,
+    cfg,
     extra_meta: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Write a servable model artifact: orbax params + config sidecar."""
@@ -62,7 +76,11 @@ def save_artifact(
         ckptr.save(
             os.path.join(os.path.abspath(path), "params"), params, force=True
         )
-    meta = {"model_config": _cfg_to_dict(cfg), "format": "substratus-tpu-v1"}
+    meta = {
+        "model_config": _cfg_to_dict(cfg),
+        "family": _family_of(cfg),
+        "format": "substratus-tpu-v1",
+    }
     meta.update(extra_meta or {})
     with open(os.path.join(path, META_FILE), "w") as f:
         json.dump(meta, f, indent=2)
@@ -83,30 +101,30 @@ def maybe_restore_orbax(
     if not os.path.exists(meta_path):
         return None
     import orbax.checkpoint as ocp
-    from substratus_tpu.models import llama
     from substratus_tpu.parallel.sharding import DEFAULT_RULES
 
     with open(meta_path) as f:
         meta = json.load(f)
-    cfg = _cfg_from_dict(meta["model_config"])
+    family = _family_module(meta.get("family", "llama"))
+    cfg = _cfg_from_dict(meta["model_config"], meta.get("family", "llama"))
     if meta.get("quantize") == "int8":
         from substratus_tpu.ops.quant import quantize_params
 
         shapes = jax.eval_shape(
             lambda: quantize_params(
-                llama.init_params(cfg, jax.random.key(0)),
-                llama.quant_contracting(cfg),
+                family.init_params(cfg, jax.random.key(0)),
+                family.quant_contracting(cfg),
             )
         )
     else:
         shapes = jax.eval_shape(
-            lambda: llama.init_params(cfg, jax.random.key(0))
+            lambda: family.init_params(cfg, jax.random.key(0))
         )
     if mesh is not None:
         from substratus_tpu.parallel.sharding import sharding_tree
 
         shardings = sharding_tree(
-            shapes, mesh, llama.param_logical_axes(cfg), rules or DEFAULT_RULES
+            shapes, mesh, family.param_logical_axes(cfg), rules or DEFAULT_RULES
         )
     else:
         one = jax.sharding.SingleDeviceSharding(jax.devices()[0])

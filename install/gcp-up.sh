@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# GKE bring-up with TPU node pools (reference: install/gcp/up.sh:17-111,
+# which provisioned NAP + L4 GPU pools; this provisions v5e TPU pools).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PROJECT=${PROJECT:-$(gcloud config get-value project)}
+REGION=${REGION:-us-central2}
+ZONE=${ZONE:-us-central2-b}
+CLUSTER=${CLUSTER:-substratus}
+BUCKET=${BUCKET:-${PROJECT}-substratus-artifacts}
+
+gcloud container clusters create "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE" \
+  --release-channel rapid \
+  --workload-pool="${PROJECT}.svc.id.goog" \
+  --addons GcsFuseCsiDriver \
+  --machine-type e2-standard-4 --num-nodes 1
+
+# Single-host v5e pool (1-8 chips per node, autoscaled to zero when idle).
+gcloud container node-pools create tpu-v5e-single \
+  --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
+  --machine-type ct5lp-hightpu-4t \
+  --enable-autoscaling --min-nodes 0 --max-nodes 8 --num-nodes 0 \
+  --spot
+
+# Multi-host v5e-16 slice pool (4 hosts x 4 chips; JobSet gangs land here).
+gcloud container node-pools create tpu-v5e-16 \
+  --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
+  --machine-type ct5lp-hightpu-4t \
+  --tpu-topology 4x4 \
+  --enable-autoscaling --min-nodes 0 --max-nodes 4 --num-nodes 0 \
+  --spot
+
+# JobSet controller (multi-host slice gangs).
+kubectl apply --server-side -f \
+  https://github.com/kubernetes-sigs/jobset/releases/latest/download/manifests.yaml
+
+gsutil mb -p "$PROJECT" "gs://${BUCKET}" 2>/dev/null || true
+
+make install-manifests
+kubectl apply -f install/substratus-tpu.yaml
+kubectl create configmap system -n substratus \
+  --from-literal=CLOUD=gcp \
+  --from-literal=PROJECT_ID="$PROJECT" \
+  --from-literal=CLUSTER_NAME="$CLUSTER" \
+  --from-literal=ARTIFACT_BUCKET_URL="gs://${BUCKET}" \
+  --from-literal=REGISTRY_URL="gcr.io/${PROJECT}/substratus" \
+  --from-literal=PRINCIPAL="substratus@${PROJECT}.iam.gserviceaccount.com" \
+  --from-literal=SCI_BACKEND=gcs \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "GKE cluster '$CLUSTER' ready with v5e pools; try the examples/ CRs"

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Local kind cluster bring-up (reference: install/kind/up.sh).
+# Creates a kind cluster with the /bucket hostPath + NodePort 30080 mapping
+# the local SCI storage handler needs, then installs CRDs + operator + SCI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CLUSTER=${CLUSTER:-substratus}
+
+cat <<EOF | kind create cluster --name "$CLUSTER" --config=-
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+nodes:
+  - role: control-plane
+    extraMounts:
+      - hostPath: /tmp/substratus-bucket
+        containerPath: /bucket
+    extraPortMappings:
+      - containerPort: 30080
+        hostPort: 30080
+EOF
+
+make install-manifests
+kubectl apply -f install/substratus-tpu.yaml
+kubectl create configmap system -n substratus \
+  --from-literal=CLOUD=local \
+  --from-literal=CLUSTER_NAME="$CLUSTER" \
+  --from-literal=ARTIFACT_BUCKET_URL=local:///bucket \
+  --from-literal=REGISTRY_URL=localhost:5000 \
+  --from-literal=PRINCIPAL=local \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "kind cluster '$CLUSTER' ready; try: sub apply -f examples/facebook-opt-125m/ --wait"

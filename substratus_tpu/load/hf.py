@@ -157,6 +157,12 @@ def config_from_hf_opt(hf_cfg: Any):
             "post-LN OPT variants (do_layer_norm_before=false, e.g. "
             "opt-350m) are not supported"
         )
+    act = getattr(hf_cfg, "activation_function", "relu")
+    if act != "relu":
+        raise NotImplementedError(
+            f"OPT activation {act!r} not supported (e.g. Galactica uses "
+            "gelu); models/opt.py implements relu"
+        )
     proj = getattr(hf_cfg, "word_embed_proj_dim", hf_cfg.hidden_size)
     if proj != hf_cfg.hidden_size:
         raise NotImplementedError(
@@ -217,6 +223,22 @@ def convert_opt_state_dict(sd: Mapping[str, Any], cfg, dtype=jnp.bfloat16) -> Pa
     }
 
 
+def _dispatch_hf(model_type: str):
+    """transformers model_type -> (config_fn, convert_fn), via the family
+    registry (models/registry.py is the single dispatch table)."""
+    from substratus_tpu.models.registry import HF_MODEL_TYPES
+
+    family = HF_MODEL_TYPES.get(model_type)
+    if family == "opt":
+        return config_from_hf_opt, convert_opt_state_dict
+    if family == "llama":
+        return config_from_hf, convert_llama_state_dict
+    raise NotImplementedError(
+        f"unsupported HF model_type {model_type!r} "
+        f"(supported: {sorted(HF_MODEL_TYPES)})"
+    )
+
+
 def load_pretrained(
     path_or_name: str, dtype=jnp.bfloat16
 ) -> Tuple[LlamaConfig, Params]:
@@ -230,13 +252,8 @@ def load_pretrained(
         from types import SimpleNamespace
 
         hf_ns = SimpleNamespace(**raw)
-        model_type = raw.get("model_type", "llama")
-        if model_type == "opt":
-            cfg = config_from_hf_opt(hf_ns)
-            convert = convert_opt_state_dict
-        else:  # llama / mistral / mixtral families
-            cfg = config_from_hf(hf_ns)
-            convert = convert_llama_state_dict
+        cfg, convert = _dispatch_hf(raw.get("model_type", "llama"))
+        cfg = cfg(hf_ns)
         sd: Dict[str, np.ndarray] = {}
         st_files = [
             f for f in os.listdir(path_or_name) if f.endswith(".safetensors")
@@ -271,10 +288,6 @@ def load_pretrained(
 
     hf_cfg = AutoConfig.from_pretrained(path_or_name)
     model = AutoModelForCausalLM.from_pretrained(path_or_name)
-    if getattr(hf_cfg, "model_type", "llama") == "opt":
-        cfg = config_from_hf_opt(hf_cfg)
-        params = convert_opt_state_dict(model.state_dict(), cfg, dtype)
-    else:
-        cfg = config_from_hf(hf_cfg)
-        params = convert_llama_state_dict(model.state_dict(), cfg, dtype)
-    return cfg, params
+    cfg_fn, convert = _dispatch_hf(getattr(hf_cfg, "model_type", "llama"))
+    cfg = cfg_fn(hf_cfg)
+    return cfg, convert(model.state_dict(), cfg, dtype)

@@ -68,7 +68,7 @@ class Engine:
         self,
         cfg: LlamaConfig,
         params: Params,
-        ec: EngineConfig = EngineConfig(),
+        ec: Optional[EngineConfig] = None,
         mesh=None,
         model=llama,
     ):
@@ -80,6 +80,11 @@ class Engine:
         data-parallel batch); the KV cache shards the same way, so decode
         collectives ride ICI. Constraint: the tensor axis must divide
         n_kv_heads (llama2-70b: KH=8 => tensor<=8 per data replica)."""
+        import dataclasses as _dc
+
+        # Copy the config before clamping: mutating a caller's (or the
+        # default) EngineConfig instance would leak between engines.
+        ec = _dc.replace(ec) if ec is not None else EngineConfig()
         self.cfg, self.params, self.ec = cfg, params, ec
         self.model = model
         # The cache may never outrun the model's position space (learned

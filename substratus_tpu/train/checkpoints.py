@@ -47,18 +47,6 @@ def _cfg_from_dict(d: Dict[str, Any], family: str = "llama"):
     return registry.config_class(family)(**d)
 
 
-def _family_of(cfg) -> str:
-    from substratus_tpu.models import registry
-
-    return registry.family_of(cfg)
-
-
-def _family_module(name: str):
-    from substratus_tpu.models import registry
-
-    return registry.module_for(name)
-
-
 def save_artifact(
     path: str,
     params: Params,
@@ -76,9 +64,11 @@ def save_artifact(
         ckptr.save(
             os.path.join(os.path.abspath(path), "params"), params, force=True
         )
+    from substratus_tpu.models import registry
+
     meta = {
         "model_config": _cfg_to_dict(cfg),
-        "family": _family_of(cfg),
+        "family": registry.family_of(cfg),
         "format": "substratus-tpu-v1",
     }
     meta.update(extra_meta or {})
@@ -105,7 +95,9 @@ def maybe_restore_orbax(
 
     with open(meta_path) as f:
         meta = json.load(f)
-    family = _family_module(meta.get("family", "llama"))
+    from substratus_tpu.models import registry
+
+    family = registry.module_for(meta.get("family", "llama"))
     cfg = _cfg_from_dict(meta["model_config"], meta.get("family", "llama"))
     if meta.get("quantize") == "int8":
         from substratus_tpu.ops.quant import quantize_params

@@ -5,7 +5,6 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 PROJECT=${PROJECT:-$(gcloud config get-value project)}
-REGION=${REGION:-us-central2}
 ZONE=${ZONE:-us-central2-b}
 CLUSTER=${CLUSTER:-substratus}
 BUCKET=${BUCKET:-${PROJECT}-substratus-artifacts}

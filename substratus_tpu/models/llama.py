@@ -32,6 +32,9 @@ from substratus_tpu.ops.quant import materialize
 
 Params = Dict[str, Any]
 
+# The engine may store this family's KV cache int8-quantized (init_cache).
+SUPPORTS_INT8_KV = True
+
 
 @dataclass(frozen=True)
 class LlamaConfig:
@@ -211,16 +214,30 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
 def init_cache(
     cfg: LlamaConfig, batch: int, max_len: Optional[int] = None, dtype=None
 ) -> Params:
-    """Decode KV cache, layers-stacked: k/v [L, B, S, KH, head_dim]."""
+    """Decode KV cache, layers-stacked: k/v [L, B, S, KH, head_dim].
+
+    dtype=jnp.int8 stores entries quantized per-vector (ops/quant.py
+    quantize_kv) with f32 scales alongside — decode is bandwidth-bound on
+    the cache read, so int8 roughly halves its HBM traffic.
+    """
     S = max_len or cfg.max_seq_len
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_size)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if dtype == jnp.int8:
+        sshape = shape[:-1] + (1,)
+        cache["k_scale"] = jnp.ones(sshape, jnp.float32)
+        cache["v_scale"] = jnp.ones(sshape, jnp.float32)
+    return cache
 
 
-def cache_logical_axes(cfg: LlamaConfig) -> Params:
+def cache_logical_axes(cfg: LlamaConfig, quantized: bool = False) -> Params:
     ax = ("layers", "cache_batch", "cache_seq", "kv_heads", "head_dim")
-    return {"k": ax, "v": ax}
+    axes = {"k": ax, "v": ax}
+    if quantized:
+        axes["k_scale"] = ax
+        axes["v_scale"] = ax
+    return axes
 
 
 def _self_attention(
@@ -350,16 +367,17 @@ def _block(
     lp: Params,  # single-layer params (leading L axis removed by scan)
     positions: jnp.ndarray,  # [B, S]
     cfg: LlamaConfig,
-    layer_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+    layer_cache: Optional[Params],  # per-layer cache dict (k, v, [scales])
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
     lora_layers: Optional[Params] = None,  # single-layer adapter tree
     lora_scale: float = 1.0,
     train: bool = False,
-) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
-    """One transformer block. Returns (x_out, (k_entries, v_entries), aux)
-    where k/v entries are either the freshly computed seq entries (no cache:
-    training / prefill) or the updated full cache rows (decode), and aux is
-    the MoE load-balancing loss (0 for dense layers)."""
+) -> Tuple[jnp.ndarray, Params, jnp.ndarray]:
+    """One transformer block. Returns (x_out, kv_out, aux): kv_out is a dict
+    of either the freshly computed seq entries {k, v} (no cache: training /
+    prefill) or the updated full cache rows (decode — including k_scale/
+    v_scale when the cache is int8-quantized); aux is the MoE
+    load-balancing loss (0 for dense layers)."""
     dt = cfg.dtype
     lora = lora_layers or {}
 
@@ -378,18 +396,41 @@ def _block(
 
     if layer_cache is None:
         attn = _self_attention(q, kk, vv, positions, cfg)
-        kv_out = (kk, vv)
+        kv_out = {"k": kk, "v": vv}
     else:
-        k_cache, v_cache = layer_cache  # [B, S, KH, hd]
+        from substratus_tpu.ops.quant import dequantize_kv, quantize_kv
+
         b = x.shape[0]
         rows = jnp.arange(b)[:, None]
-        k_cache = k_cache.at[rows, positions].set(kk.astype(k_cache.dtype))
-        v_cache = v_cache.at[rows, positions].set(vv.astype(v_cache.dtype))
+        quantized = "k_scale" in layer_cache
+        kv_out = {}
+        if quantized:
+            kq, kscale = quantize_kv(kk)
+            vq, vscale = quantize_kv(vv)
+            kv_out["k"] = layer_cache["k"].at[rows, positions].set(kq)
+            kv_out["v"] = layer_cache["v"].at[rows, positions].set(vq)
+            kv_out["k_scale"] = (
+                layer_cache["k_scale"].at[rows, positions].set(kscale)
+            )
+            kv_out["v_scale"] = (
+                layer_cache["v_scale"].at[rows, positions].set(vscale)
+            )
+            k_cache = dequantize_kv(kv_out["k"], kv_out["k_scale"], dt)
+            v_cache = dequantize_kv(kv_out["v"], kv_out["v_scale"], dt)
+        else:
+            kv_out["k"] = (
+                layer_cache["k"].at[rows, positions]
+                .set(kk.astype(layer_cache["k"].dtype))
+            )
+            kv_out["v"] = (
+                layer_cache["v"].at[rows, positions]
+                .set(vv.astype(layer_cache["v"].dtype))
+            )
+            k_cache, v_cache = kv_out["k"], kv_out["v"]
         attn = dot_product_attention(
             q, k_cache, v_cache, causal=True, q_positions=positions,
             kv_length=kv_length,
         )
-        kv_out = (k_cache, v_cache)
 
     b, s = x.shape[:2]
     attn_flat = attn.reshape(b, s, -1)
@@ -454,13 +495,12 @@ def forward(
 
     xs: Dict[str, Any] = {"lp": params["layers"]}
     if cache is not None:
-        xs["cache"] = (cache["k"], cache["v"])
+        xs["cache"] = cache
     if lora is not None:
         xs["lora"] = lora["layers"]
     if remat:
         body = jax.checkpoint(body)
     x, ys = lax.scan(body, x, xs)
-    ks, vs = ys["kv"]
 
     x = rms_norm(x, params["out_norm"], cfg.norm_eps)
     if cfg.tie_embeddings:
@@ -469,10 +509,10 @@ def forward(
         )
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, materialize(params["lm_head"], cfg.dtype))
-    kv = {"k": ks, "v": vs}
+    kv = ys["kv"]  # stacked over layers; same structure as the cache
     if cfg.n_experts > 0 and cache is None:
         # Per-layer router load-balancing losses (training/prefill only —
-        # the decode cache must keep a stable {k, v} structure for buffer
+        # the decode cache must keep a stable structure for buffer
         # donation); the trainer adds router_aux_weight * mean.
         kv["moe_aux"] = ys["aux"]
     return logits.astype(jnp.float32), kv

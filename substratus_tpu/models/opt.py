@@ -139,7 +139,7 @@ def init_cache(
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def cache_logical_axes(cfg: OPTConfig) -> Params:
+def cache_logical_axes(cfg: OPTConfig, quantized: bool = False) -> Params:
     ax = ("layers", "cache_batch", "cache_seq", "kv_heads", "head_dim")
     return {"k": ax, "v": ax}
 

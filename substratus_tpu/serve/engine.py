@@ -42,6 +42,9 @@ class EngineConfig:
     max_prefill_len: int = 512
     top_k: int = 0  # static top-k (0 = disabled)
     eos_token_id: int = 2
+    # "model" keeps the cache in the model dtype; "int8" stores entries
+    # quantized per-vector (llama family) — decode cache reads halve.
+    kv_cache_dtype: str = "model"
 
 
 @dataclass
@@ -94,6 +97,18 @@ class Engine:
         ec.max_prefill_len = min(ec.max_prefill_len, ec.max_seq_len)
         B, S = ec.max_batch, ec.max_seq_len
 
+        if ec.kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype {ec.kv_cache_dtype!r} invalid "
+                "(expected 'model' or 'int8')"
+            )
+        kv_int8 = ec.kv_cache_dtype == "int8"
+        if kv_int8 and not getattr(model, "SUPPORTS_INT8_KV", False):
+            raise ValueError(
+                f"kv_cache_dtype=int8 unsupported for {model.__name__}"
+            )
+        cache_dtype = jnp.int8 if kv_int8 else None
+
         self.mesh = mesh
         if mesh is not None:
             from substratus_tpu.parallel.sharding import SERVE_RULES, shard_tree
@@ -102,13 +117,13 @@ class Engine:
                 params, mesh, model.param_logical_axes(cfg), SERVE_RULES
             )
             self.cache = shard_tree(
-                model.init_cache(cfg, B, S),
+                model.init_cache(cfg, B, S, dtype=cache_dtype),
                 mesh,
-                model.cache_logical_axes(cfg),
+                model.cache_logical_axes(cfg, quantized=kv_int8),
                 SERVE_RULES,
             )
         else:
-            self.cache = model.init_cache(cfg, B, S)
+            self.cache = model.init_cache(cfg, B, S, dtype=cache_dtype)
         self.tokens = jnp.zeros((B,), jnp.int32)
         self.positions = jnp.zeros((B,), jnp.int32)
         self.temps = jnp.zeros((B,), jnp.float32)
@@ -149,17 +164,26 @@ class Engine:
     def _build_insert(self):
         @partial(jax.jit, donate_argnums=(0,))
         def insert(cache, kv, slot):
-            # kv: [L, 1, Sb, KH, hd] fragment -> write into cache[:, slot, :Sb]
-            sb = kv["k"].shape[2]
-            k = jax.lax.dynamic_update_slice(
-                cache["k"], kv["k"].astype(cache["k"].dtype),
-                (0, slot, 0, 0, 0),
-            )
-            v = jax.lax.dynamic_update_slice(
-                cache["v"], kv["v"].astype(cache["v"].dtype),
-                (0, slot, 0, 0, 0),
-            )
-            return {"k": k, "v": v}
+            # kv: {k, v} fragment [L, 1, Sb, KH, hd] (bf16 from prefill) ->
+            # write into cache[:, slot, :Sb], quantizing when the cache is
+            # int8.
+            if "k_scale" in cache:
+                from substratus_tpu.ops.quant import quantize_kv
+
+                kq, ks = quantize_kv(kv["k"])
+                vq, vs = quantize_kv(kv["v"])
+                frag = {"k": kq, "k_scale": ks, "v": vq, "v_scale": vs}
+            else:
+                frag = {
+                    "k": kv["k"].astype(cache["k"].dtype),
+                    "v": kv["v"].astype(cache["v"].dtype),
+                }
+            return {
+                key: jax.lax.dynamic_update_slice(
+                    cache[key], frag[key], (0, slot, 0, 0, 0)
+                )
+                for key in cache
+            }
 
         return insert
 

@@ -110,6 +110,7 @@ def main(argv=None) -> int:
         max_batch=max_batch,
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
         eos_token_id=tokenizer.eos_id if tokenizer.eos_id is not None else 2,
+        kv_cache_dtype=params_json.get("kv_cache_dtype", "model"),
     )
     # Multi-chip serving: tensor-parallel over as many chips as the kv heads
     # allow (params.json {"tensor": N} overrides), data-parallel the rest.

@@ -223,6 +223,100 @@ def convert_opt_state_dict(sd: Mapping[str, Any], cfg, dtype=jnp.bfloat16) -> Pa
     }
 
 
+def config_from_hf_falcon(hf_cfg: Any):
+    from substratus_tpu.models.falcon import FalconConfig
+
+    get = lambda n, d=None: getattr(hf_cfg, n, d)
+    if not get("parallel_attn", True):
+        raise NotImplementedError("non-parallel Falcon blocks not supported")
+    if get("alibi", False):
+        raise NotImplementedError("Falcon alibi positioning not supported")
+    if get("bias", False):
+        raise NotImplementedError("biased Falcon projections not supported")
+    if not get("tie_word_embeddings", True):
+        raise NotImplementedError(
+            "untied Falcon LM heads not supported (forward scores against "
+            "the tied token embedding)"
+        )
+    new_arch = bool(get("new_decoder_architecture", False))
+    if new_arch:
+        kv = get("num_kv_heads") or hf_cfg.num_attention_heads
+    elif get("multi_query", True):
+        kv = 1
+    else:
+        kv = hf_cfg.num_attention_heads
+    return FalconConfig(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=kv,
+        rope_theta=get("rope_theta", 10000.0),
+        norm_eps=get("layer_norm_epsilon", 1e-5),
+        max_seq_len=get("max_position_embeddings", 2048),
+        separate_ln=new_arch,
+    )
+
+
+def convert_falcon_state_dict(sd: Mapping[str, Any], cfg, dtype=jnp.bfloat16) -> Params:
+    """HF FalconForCausalLM state dict -> models/falcon.py params. The fused
+    query_key_value weight interleaves per kv-group: (H/KH) query heads, one
+    key head, one value head."""
+    hd = cfg.head_size
+    L, D, H, KH = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads
+    G = H // KH
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("transformer.", "model.transformer.", ""):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    def split_qkv(w: np.ndarray):
+        # w: [(H + 2*KH)*hd, D] -> per-group [G q | k | v]
+        grouped = w.reshape(KH, G + 2, hd, D)
+        q = grouped[:, :G].reshape(H, hd, D).transpose(2, 0, 1)  # [D,H,hd]
+        k = grouped[:, G].transpose(2, 0, 1)  # [D,KH,hd]
+        v = grouped[:, G + 1].transpose(2, 0, 1)
+        return q, k, v
+
+    qs, ks, vs = [], [], []
+    for i in range(L):
+        q, k, v = split_qkv(get(f"h.{i}.self_attention.query_key_value.weight"))
+        qs.append(q)
+        ks.append(k)
+        vs.append(v)
+
+    def stack(fmt: str, transform) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([transform(get(fmt.format(i=i))) for i in range(L)]), dtype
+        )
+
+    ln1 = "h.{i}.ln_attn" if cfg.separate_ln else "h.{i}.input_layernorm"
+    layers = {
+        "ln1_scale": stack(ln1 + ".weight", lambda w: w),
+        "ln1_bias": stack(ln1 + ".bias", lambda w: w),
+        "wq": jnp.asarray(np.stack(qs), dtype),
+        "wk": jnp.asarray(np.stack(ks), dtype),
+        "wv": jnp.asarray(np.stack(vs), dtype),
+        "wo": stack(
+            "h.{i}.self_attention.dense.weight",
+            lambda w: w.T.reshape(H, hd, D),
+        ),
+        "fc1": stack("h.{i}.mlp.dense_h_to_4h.weight", lambda w: w.T),
+        "fc2": stack("h.{i}.mlp.dense_4h_to_h.weight", lambda w: w.T),
+    }
+    if cfg.separate_ln:
+        layers["ln2_scale"] = stack("h.{i}.ln_mlp.weight", lambda w: w)
+        layers["ln2_bias"] = stack("h.{i}.ln_mlp.bias", lambda w: w)
+    return {
+        "tok_embed": jnp.asarray(get("word_embeddings.weight"), dtype),
+        "layers": layers,
+        "final_ln_scale": jnp.asarray(get("ln_f.weight"), dtype),
+        "final_ln_bias": jnp.asarray(get("ln_f.bias"), dtype),
+    }
+
+
 def _dispatch_hf(model_type: str):
     """transformers model_type -> (config_fn, convert_fn), via the family
     registry (models/registry.py is the single dispatch table)."""
@@ -233,6 +327,8 @@ def _dispatch_hf(model_type: str):
         return config_from_hf_opt, convert_opt_state_dict
     if family == "llama":
         return config_from_hf, convert_llama_state_dict
+    if family == "falcon":
+        return config_from_hf_falcon, convert_falcon_state_dict
     raise NotImplementedError(
         f"unsupported HF model_type {model_type!r} "
         f"(supported: {sorted(HF_MODEL_TYPES)})"

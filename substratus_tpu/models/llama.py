@@ -34,6 +34,8 @@ Params = Dict[str, Any]
 
 # The engine may store this family's KV cache int8-quantized (init_cache).
 SUPPORTS_INT8_KV = True
+# train/lora.py adapters are implemented for this family's projections.
+SUPPORTS_LORA = True
 
 
 @dataclass(frozen=True)

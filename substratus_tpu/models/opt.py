@@ -144,7 +144,7 @@ def cache_logical_axes(cfg: OPTConfig, quantized: bool = False) -> Params:
     return {"k": ax, "v": ax}
 
 
-def _block(x, lp, positions, cfg, layer_cache):
+def _block(x, lp, positions, cfg, layer_cache, kv_length=None):
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"]) + lp["bq"]
     kk = jnp.einsum("bsd,dhk->bshk", h, lp["wk"]) + lp["bk"]
@@ -159,7 +159,8 @@ def _block(x, lp, positions, cfg, layer_cache):
         k_cache = k_cache.at[rows, positions].set(kk.astype(k_cache.dtype))
         v_cache = v_cache.at[rows, positions].set(vv.astype(v_cache.dtype))
         attn = dot_product_attention(
-            q, k_cache, v_cache, causal=True, q_positions=positions
+            q, k_cache, v_cache, causal=True, q_positions=positions,
+            kv_length=kv_length,
         )
         kv_out = (k_cache, v_cache)
 
@@ -177,11 +178,13 @@ def forward(
     *,
     positions: Optional[jnp.ndarray] = None,
     cache: Optional[Params] = None,
-    kv_length: Optional[jnp.ndarray] = None,  # engine-interface parity
-    lora=None,  # unsupported for OPT (engine never passes it)
+    kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
+    lora=None,  # not implemented for this family: rejected loudly
     remat: bool = False,
     train: bool = False,
 ) -> Tuple[jnp.ndarray, Params]:
+    if lora is not None:
+        raise NotImplementedError("LoRA adapters not implemented for opt")
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -190,7 +193,9 @@ def forward(
 
     def body(carry, layer_in):
         lp = layer_in["lp"]
-        x_out, kv = _block(carry, lp, positions, cfg, layer_in.get("cache"))
+        x_out, kv = _block(
+            carry, lp, positions, cfg, layer_in.get("cache"), kv_length
+        )
         return x_out, kv
 
     xs: Dict[str, Any] = {"lp": params["layers"]}

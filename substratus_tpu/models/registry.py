@@ -10,11 +10,12 @@ from __future__ import annotations
 
 from typing import Any, Optional, Tuple
 
-from substratus_tpu.models import llama, opt
+from substratus_tpu.models import falcon, llama, opt
 
 FAMILIES = {
     "llama": llama,  # Llama 2/3, Mistral, Mixtral (MoE), TinyLlama
     "opt": opt,  # facebook/opt-*
+    "falcon": falcon,  # falcon-7b[-instruct], falcon-40b
 }
 
 # transformers `model_type` -> family name (HF checkpoint dispatch).
@@ -23,11 +24,13 @@ HF_MODEL_TYPES = {
     "mistral": "llama",
     "mixtral": "llama",
     "opt": "opt",
+    "falcon": "falcon",
 }
 
 _CONFIG_CLASS_TO_FAMILY = {
     llama.LlamaConfig: "llama",
     opt.OPTConfig: "opt",
+    falcon.FalconConfig: "falcon",
 }
 
 

@@ -125,8 +125,24 @@ def sharding_tree(
     """
     from substratus_tpu.ops.quant import QTensor
 
+    def fit(shape, spec: P) -> P:
+        """Drop spec entries whose mesh-axis size doesn't divide the dim —
+        e.g. multi-query attention (1 kv head) with a tensor axis: the kv
+        projections replicate instead of erroring."""
+        out = []
+        for i, entry in enumerate(tuple(spec)):
+            if entry is None:
+                out.append(None)
+                continue
+            axes = (entry,) if isinstance(entry, str) else tuple(entry)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            out.append(entry if shape[i] % size == 0 else None)
+        return P(*out)
+
     def one(leaf, axes):
-        spec = rules.mesh_axes(axes)
+        spec = fit(leaf.shape, rules.mesh_axes(axes))
         if isinstance(leaf, QTensor):
             qspec = tuple(spec) + (None,) * (leaf.q.ndim - len(tuple(spec)))
             sspec = P(

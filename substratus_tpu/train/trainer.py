@@ -98,25 +98,47 @@ class Trainer:
         mesh: Mesh,
         params: Optional[Params] = None,
         rules: LogicalRules = DEFAULT_RULES,
+        model=None,
     ):
+        """model: the model-family module; resolved from the config type via
+        models/registry.py when omitted, so any registered family trains."""
+        from substratus_tpu.models import registry
+
+        self.model = model if model is not None else registry.module_of(cfg)
         self.cfg, self.tc, self.mesh, self.rules = cfg, tc, mesh, rules
         self.optimizer = make_optimizer(tc)
         key_params, key_lora = jax.random.split(jax.random.key(tc.seed))
 
-        param_sh = logical_sharding(mesh, llama.param_logical_axes(cfg), rules)
+        from substratus_tpu.parallel.sharding import sharding_tree
+
+        # sharding_tree (not logical_sharding): it sees the shapes, so
+        # non-divisible dims (e.g. MQA's single kv head vs a tensor axis)
+        # fall back to replication instead of erroring.
+        param_shapes = jax.eval_shape(
+            partial(self.model.init_params, cfg), jax.random.key(0)
+        )
+        param_sh = sharding_tree(
+            param_shapes, mesh, self.model.param_logical_axes(cfg), rules
+        )
         if params is None:
             init = jax.jit(
-                partial(llama.init_params, cfg), out_shardings=param_sh
+                partial(self.model.init_params, cfg), out_shardings=param_sh
             )
             params = init(key_params)
         else:
             # shard_tree handles both dense and int8-QTensor (QLoRA) bases.
             params = shard_tree(
-                params, mesh, llama.param_logical_axes(cfg), rules
+                params, mesh, self.model.param_logical_axes(cfg), rules
             )
         self.params = params
         self.param_shardings = param_sh
 
+        if tc.lora_rank > 0 and not getattr(self.model, "SUPPORTS_LORA", False):
+            raise NotImplementedError(
+                f"LoRA is not implemented for the "
+                f"{self.model.__name__.split('.')[-1]} family; use full "
+                "finetuning (lora_rank: 0)"
+            )
         if tc.lora_rank > 0:
             adapters = lora_lib.init_lora(
                 cfg, key_lora, rank=tc.lora_rank, alpha=tc.lora_alpha
@@ -183,7 +205,7 @@ class Trainer:
                 lora = {"layers": trainable, "scale": lora_scale}
             else:
                 params, lora = trainable, None
-            logits, kv = llama.forward(
+            logits, kv = self.model.forward(
                 params,
                 batch["tokens"],
                 cfg,

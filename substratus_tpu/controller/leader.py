@@ -1,0 +1,132 @@
+"""Lease-based leader election (reference: controller-runtime leader
+election, cmd/controllermanager/main.go:51-68 — only one manager replica
+reconciles at a time).
+
+Standard coordination.k8s.io/Lease protocol: acquire when unheld or
+expired, renew at a fraction of the lease duration, step down by letting
+the lease lapse. `run_with_leadership` blocks until elected, then keeps
+renewing on a daemon thread; if renewal fails (apiserver partition, lease
+stolen) the process exits so the replacement replica takes over — crash-
+and-restart beats split-brain reconciling.
+"""
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from substratus_tpu.kube.client import Conflict, KubeClient, NotFound
+
+log = logging.getLogger("substratus.leader")
+
+LEASE_NAME = "substratus-controller-manager"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _parse(ts: Optional[str]) -> Optional[datetime.datetime]:
+    if not ts:
+        return None
+    return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str = "substratus",
+        identity: Optional[str] = None,
+        lease_seconds: int = 15,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self.lease_seconds = lease_seconds
+
+    def _try_acquire(self) -> bool:
+        now = _now()
+        stamp = now.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+        try:
+            lease = self.client.get("Lease", self.namespace, LEASE_NAME)
+        except NotFound:
+            try:
+                self.client.create(
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {
+                            "name": LEASE_NAME,
+                            "namespace": self.namespace,
+                        },
+                        "spec": {
+                            "holderIdentity": self.identity,
+                            "leaseDurationSeconds": self.lease_seconds,
+                            "renewTime": stamp,
+                        },
+                    }
+                )
+                return True
+            except Conflict:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = _parse(spec.get("renewTime"))
+        expired = renew is None or (
+            now - renew
+        ).total_seconds() > spec.get("leaseDurationSeconds", self.lease_seconds)
+        if holder not in (None, "", self.identity) and not expired:
+            return False
+        lease["spec"] = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": self.lease_seconds,
+            "renewTime": stamp,
+        }
+        try:
+            self.client.update(lease)
+            return True
+        except Conflict:
+            return False  # raced another candidate; retry
+
+    def acquire_blocking(self) -> None:
+        while not self._try_acquire():
+            log.info("waiting for leadership (%s)", self.identity)
+            time.sleep(self.lease_seconds / 3)
+        log.info("acquired leadership as %s", self.identity)
+
+    def keep_renewing(self, on_lost=None) -> threading.Thread:
+        def lost():
+            log.error("lost leadership; exiting for failover")
+            if on_lost is not None:
+                on_lost()
+            else:
+                # os._exit, not sys.exit: SystemExit raised in a daemon
+                # thread kills only that thread — the ex-leader would keep
+                # reconciling (the split-brain this module exists to stop).
+                os._exit(1)
+
+        def loop():
+            last_renewed = time.monotonic()
+            while True:
+                time.sleep(self.lease_seconds / 3)
+                try:
+                    ok = self._try_acquire()
+                except Exception:
+                    # Transient apiserver/network errors are failed
+                    # renewals, not thread-killers: keep retrying until the
+                    # lease deadline passes.
+                    log.exception("lease renewal error")
+                    ok = False
+                if ok:
+                    last_renewed = time.monotonic()
+                elif time.monotonic() - last_renewed > self.lease_seconds:
+                    lost()
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t

@@ -55,6 +55,10 @@ def main(argv=None) -> int:
         "--fake", action="store_true",
         help="in-memory apiserver + fake SCI (local development)",
     )
+    ap.add_argument(
+        "--leader-elect", action="store_true",
+        help="Lease-based leader election (multi-replica deployments)",
+    )
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -71,13 +75,23 @@ def main(argv=None) -> int:
         client = RealKube.in_cluster()
         sci = GrpcSCIClient(args.sci_address)
 
+    # Health must serve BEFORE election blocks: a standby replica that
+    # can't answer its liveness probe gets crash-looped and there is never
+    # a warm standby.
+    from substratus_tpu.observability.health import serve_health
+
+    serve_health(port=args.probe_port, manager=None)
+
+    if args.leader_elect and not args.fake:
+        from substratus_tpu.controller.leader import LeaderElector
+
+        elector = LeaderElector(client)
+        elector.acquire_blocking()
+        elector.keep_renewing()
+
     mgr = build_manager(client, cloud, sci)
     mgr.bootstrap()
     thread = mgr.start()
-
-    from substratus_tpu.observability.health import serve_health
-
-    serve_health(port=args.probe_port, manager=mgr)
     thread.join()
     return 0
 

@@ -41,7 +41,14 @@ RESOURCE_MAP: Dict[str, tuple] = {
     "Job": ("/apis/batch/v1", "jobs"),
     "Deployment": ("/apis/apps/v1", "deployments"),
     "JobSet": ("/apis/jobset.x-k8s.io/v1alpha2", "jobsets"),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases"),
 }
+
+# Kinds the controller watches. Lease is deliberately excluded: the elector
+# only gets/updates one Lease, and a cluster-wide Lease watch would stream
+# every node heartbeat and kube-system leader renewal into the workqueue
+# (and typically 403 under the manager's RBAC anyway).
+WATCHED_KINDS = tuple(k for k in RESOURCE_MAP if k != "Lease")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -155,7 +162,7 @@ class RealKube(KubeClient):
         start_watches = not self._listeners
         self._listeners.append(fn)
         if start_watches:
-            for kind in RESOURCE_MAP:
+            for kind in WATCHED_KINDS:
                 t = threading.Thread(
                     target=self._watch_loop, args=(kind,), daemon=True
                 )

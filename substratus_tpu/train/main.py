@@ -109,6 +109,7 @@ def main(argv=None) -> int:
         lora_alpha=float(p.get("lora_alpha", 16.0)),
         remat=bool(p.get("remat", True)),
         seed=int(p.get("seed", 0)),
+        grad_accum_steps=int(p.get("grad_accum_steps", 1)),
     )
     trainer = Trainer(cfg, tc, mesh, params=params)
     data = PackedDataset(

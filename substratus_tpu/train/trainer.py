@@ -54,19 +54,33 @@ class TrainConfig:
     lora_alpha: float = 16.0
     remat: bool = True
     seed: int = 0
+    # Gradient accumulation: the global batch splits into this many
+    # microbatches scanned inside the jitted step (activation memory scales
+    # with the microbatch, optimizer cadence with the global batch).
+    grad_accum_steps: int = 1
 
 
-def cross_entropy_loss(
+def cross_entropy_sum(
     logits: jnp.ndarray,  # [B, S, V] float32
     targets: jnp.ndarray,  # [B, S] int32
     weights: Optional[jnp.ndarray] = None,  # [B, S] 0/1 loss mask
-) -> jnp.ndarray:
+) -> tuple:
+    """(weighted nll sum, weight sum) — the accumulation-friendly form."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if weights is None:
-        return nll.mean()
+        weights = jnp.ones_like(nll)
     weights = weights.astype(jnp.float32)
-    return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    return (nll * weights).sum(), weights.sum()
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    s, w = cross_entropy_sum(logits, targets, weights)
+    return s / jnp.maximum(w, 1.0)
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -220,10 +234,70 @@ class Trainer:
                 loss = loss + cfg.router_aux_weight * kv["moe_aux"].mean()
             return loss
 
-        def train_step(trainable, frozen_params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(
-                trainable, frozen_params, batch
+        accum = max(1, tc.grad_accum_steps)
+
+        def sum_loss_fn(trainable, frozen_params, mb):
+            """(weighted-nll sum [+ token-weighted moe aux], weight sum) —
+            summing (not averaging) per microbatch makes accumulation
+            exactly equal to the single-step update even when loss-mask
+            token counts differ across microbatches."""
+            if lora_mode:
+                params = frozen_params
+                lora = {"layers": trainable, "scale": lora_scale}
+            else:
+                params, lora = trainable, None
+            logits, kv = self.model.forward(
+                params, mb["tokens"], cfg, lora=lora, remat=tc.remat,
+                train=True,
             )
+            s, w = cross_entropy_sum(
+                logits[:, :-1], mb["tokens"][:, 1:], mb["weights"][:, 1:]
+            )
+            if "moe_aux" in kv:
+                s = s + cfg.router_aux_weight * kv["moe_aux"].mean() * w
+            return s, w
+
+        def train_step(trainable, frozen_params, opt_state, batch):
+            if accum == 1:
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    trainable, frozen_params, batch
+                )
+            else:
+                # Scan microbatches, accumulating grad-of-sum in f32; one
+                # optimizer update per global batch, normalized once by the
+                # total token weight.
+                micro = jax.tree.map(
+                    lambda x: x.reshape(
+                        (accum, x.shape[0] // accum) + x.shape[1:]
+                    ),
+                    batch,
+                )
+
+                def acc_step(carry, mb):
+                    s_sum, w_sum, grads = carry
+                    (s, w), g = jax.value_and_grad(
+                        sum_loss_fn, has_aux=True
+                    )(trainable, frozen_params, mb)
+                    grads = jax.tree.map(
+                        lambda a, b: a + b.astype(jnp.float32), grads, g
+                    )
+                    return (s_sum + s, w_sum + w, grads), None
+
+                zero = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), trainable
+                )
+                (s_sum, w_sum, grads), _ = jax.lax.scan(
+                    acc_step,
+                    (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero),
+                    micro,
+                )
+                denom = jnp.maximum(w_sum, 1.0)
+                loss = s_sum / denom
+                # Cast back to param dtype so optimizer-state dtypes match
+                # the non-accumulated path (donation needs stable types).
+                grads = jax.tree.map(
+                    lambda g, p: (g / denom).astype(p.dtype), grads, trainable
+                )
             updates, opt_state = optimizer.update(
                 grads, opt_state, trainable
             )
@@ -241,6 +315,12 @@ class Trainer:
             raise ValueError(
                 f"batch size {b} must be divisible by data*fsdp={dp} "
                 f"(mesh {dict(self.mesh.shape)})"
+            )
+        accum = max(1, self.tc.grad_accum_steps)
+        if b % accum or (b // accum) % dp:
+            raise ValueError(
+                f"batch size {b} must split into grad_accum_steps={accum} "
+                f"microbatches each divisible by data*fsdp={dp}"
             )
         batch = jax.tree.map(
             lambda x: jax.device_put(x, self.batch_sharding), batch

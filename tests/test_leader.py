@@ -1,0 +1,29 @@
+"""Lease-based leader election against the fake apiserver."""
+from substratus_tpu.controller.leader import LEASE_NAME, LeaderElector
+from substratus_tpu.kube.fake import FakeKube
+
+
+def test_single_candidate_acquires_and_renews():
+    client = FakeKube()
+    a = LeaderElector(client, identity="a", lease_seconds=15)
+    assert a._try_acquire() is True
+    lease = client.get("Lease", "substratus", LEASE_NAME)
+    assert lease["spec"]["holderIdentity"] == "a"
+    assert a._try_acquire() is True  # renew keeps working
+
+
+def test_second_candidate_blocked_until_expiry():
+    client = FakeKube()
+    a = LeaderElector(client, identity="a", lease_seconds=15)
+    b = LeaderElector(client, identity="b", lease_seconds=15)
+    assert a._try_acquire() is True
+    assert b._try_acquire() is False  # fresh lease held by a
+
+    # Simulate a's death: age the renewTime past the lease duration.
+    lease = client.get("Lease", "substratus", LEASE_NAME)
+    lease["spec"]["renewTime"] = "2020-01-01T00:00:00.000000Z"
+    client.update(lease)
+    assert b._try_acquire() is True  # expired -> b takes over
+    lease = client.get("Lease", "substratus", LEASE_NAME)
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert a._try_acquire() is False  # a no longer holds it

@@ -35,6 +35,31 @@ def test_params_are_sharded(mesh8):
     assert "fsdp" in str(spec) and "tensor" in str(spec), spec
 
 
+def test_grad_accumulation_matches_single_step(mesh8):
+    """accum=4 over one batch == one full-batch step, including with a
+    non-uniform loss mask (token counts differ per microbatch)."""
+    cfg = llama.CONFIGS["tiny"].replace(dtype=jnp.float32)
+    batch = _batch(b=16)
+    # Mask out a varying prefix per row so microbatches carry different
+    # numbers of loss tokens.
+    rng = np.random.default_rng(1)
+    for i in range(16):
+        batch["weights"][i, : rng.integers(0, 24)] = 0.0
+    tc1 = TrainConfig(learning_rate=1e-2, warmup_steps=1, remat=False)
+    tc4 = TrainConfig(
+        learning_rate=1e-2, warmup_steps=1, remat=False, grad_accum_steps=4
+    )
+    t1 = Trainer(cfg, tc1, mesh8)
+    t4 = Trainer(cfg, tc4, mesh8)
+    l1 = t1.train_step(batch)
+    l4 = t4.train_step(batch)
+    assert abs(l1 - l4) < 1e-4, (l1, l4)
+    for a, b in zip(jax.tree.leaves(t1.params), jax.tree.leaves(t4.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        )
+
+
 def test_lora_only_adapters_train(mesh8):
     cfg = llama.CONFIGS["tiny"].replace(dtype=jnp.float32)
     tc = TrainConfig(learning_rate=1e-2, lora_rank=4, total_steps=20, remat=False)

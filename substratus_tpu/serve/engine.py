@@ -66,6 +66,16 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _pad_to_bucket(tokens, cap: int):
+    """Right-pad a token list to its power-of-two bucket (capped): the one
+    padding rule both the single-shot and chunked prefill paths share."""
+    true_len = len(tokens)
+    bucket = min(_bucket(true_len), cap)
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :true_len] = tokens
+    return jnp.asarray(padded), true_len
+
+
 class Engine:
     def __init__(
         self,
@@ -101,6 +111,11 @@ class Engine:
             raise ValueError(
                 f"kv_cache_dtype {ec.kv_cache_dtype!r} invalid "
                 "(expected 'model' or 'int8')"
+            )
+        if ec.max_prefill_len < 1 or ec.max_batch < 1 or ec.max_seq_len < 2:
+            raise ValueError(
+                f"invalid engine config: max_prefill_len={ec.max_prefill_len} "
+                f"max_batch={ec.max_batch} max_seq_len={ec.max_seq_len}"
             )
         kv_int8 = ec.kv_cache_dtype == "int8"
         if kv_int8 and not getattr(model, "SUPPORTS_INT8_KV", False):
@@ -146,7 +161,9 @@ class Engine:
 
         self._decode_fn = self._build_decode()
         self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
+        self._chunk_fn = partial(self._chunk_prefill_jit, self.model, self.cfg)
         self._insert_fn = self._build_insert()
+        self._extract_slot, self._restore_slot = self._build_slot_io()
 
     # --- jitted device functions -----------------------------------------
 
@@ -160,6 +177,45 @@ class Engine:
         logits, kv = model.forward(params, tokens, cfg, positions=positions)
         last = logits[0, true_len - 1]
         return last, kv
+
+    @staticmethod
+    @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+    def _chunk_prefill_jit(model, cfg, params, slot_cache, tokens, offset,
+                           true_len):
+        """One chunk of a long prefill: tokens [1, C] (right-padded) written
+        into the single-slot cache at absolute positions offset..offset+C-1.
+        Returns (logits of the last real token, updated slot cache)."""
+        c = tokens.shape[1]
+        positions = offset + jnp.arange(c, dtype=jnp.int32)[None, :]
+        # Padded tail positions all clamp onto the single slot one past the
+        # prompt: real queries never attend it (causal mask), and the first
+        # decode step writes that exact slot before reading it. The caller
+        # keeps prompts <= max_seq_len - 1 so the slot exists.
+        positions = jnp.minimum(positions, offset + true_len)
+        logits, slot_cache = model.forward(
+            params, tokens, cfg, positions=positions, cache=slot_cache
+        )
+        return logits[0, true_len - 1], slot_cache
+
+    def _build_slot_io(self):
+        @jax.jit
+        def extract(cache, slot):
+            return jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, slot, 1, axis=1),
+                cache,
+            )
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def restore(cache, slot_cache, slot):
+            return jax.tree.map(
+                lambda full, one: jax.lax.dynamic_update_slice_in_dim(
+                    full, one, slot, axis=1
+                ),
+                cache,
+                slot_cache,
+            )
+
+        return extract, restore
 
     def _build_insert(self):
         @partial(jax.jit, donate_argnums=(0,))
@@ -234,18 +290,22 @@ class Engine:
                 return
             self._admitting = req
             slot = int(np.flatnonzero(~self.active)[0])
-            # Keep the newest max_prefill_len tokens, and leave at least one
-            # free cache slot for generation.
-            keep = min(self.ec.max_prefill_len, self.ec.max_seq_len - 1)
+            # Keep the newest tokens that fit the cache (minus one slot for
+            # generation); prompts longer than one prefill bucket run as a
+            # sequence of chunked prefills against the slot's cache.
+            keep = self.ec.max_seq_len - 1
             prompt = req.prompt_tokens[-keep:]
             true_len = len(prompt)
-            bucket = min(_bucket(true_len), self.ec.max_prefill_len)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :true_len] = prompt
-            last_logits, kv = self._prefill_fn(
-                self.params, jnp.asarray(padded), true_len
-            )
-            self.cache = self._insert_fn(self.cache, kv, slot)
+            if true_len <= self.ec.max_prefill_len:
+                padded, true_len = _pad_to_bucket(
+                    prompt, self.ec.max_prefill_len
+                )
+                last_logits, kv = self._prefill_fn(
+                    self.params, padded, true_len
+                )
+                self.cache = self._insert_fn(self.cache, kv, slot)
+            else:
+                last_logits = self._chunked_prefill(prompt, slot)
             # Sample the first generated token from the prefill logits.
             self.key, subkey = jax.random.split(self.key)
             first = sample(
@@ -267,6 +327,25 @@ class Engine:
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self._admitting = None
             self._emit(slot, first_id)
+
+    def _chunked_prefill(self, prompt, slot: int):
+        """Prefill a prompt longer than one bucket: run bucket-sized chunks
+        against the slot's cache (each chunk attends everything before it),
+        then restore the slot into the decode cache."""
+        chunk = self.ec.max_prefill_len
+        slot_cache = self._extract_slot(self.cache, slot)
+        last_logits = None
+        offset = 0
+        while offset < len(prompt):
+            padded, true_len = _pad_to_bucket(
+                prompt[offset : offset + chunk], chunk
+            )
+            last_logits, slot_cache = self._chunk_fn(
+                self.params, slot_cache, padded, offset, true_len
+            )
+            offset += true_len
+        self.cache = self._restore_slot(self.cache, slot_cache, slot)
+        return last_logits
 
     def _emit(self, slot: int, token_id: int):
         req = self.slot_req[slot]

@@ -109,6 +109,9 @@ def main(argv=None) -> int:
     ec = EngineConfig(
         max_batch=max_batch,
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
+        max_prefill_len=int(
+            params_json.get("max_prefill_len", EngineConfig.max_prefill_len)
+        ),
         eos_token_id=tokenizer.eos_id if tokenizer.eos_id is not None else 2,
         kv_cache_dtype=params_json.get("kv_cache_dtype", "model"),
     )

@@ -28,7 +28,15 @@ import jax.numpy as jnp
 from substratus_tpu.models import llama
 from substratus_tpu.ops.quant import QTensor
 
-BASELINE_TOK_S_PER_CHIP = 1250.0
+# Per-config parity targets (decode is bandwidth-bound, so the 70B-derived
+# 125 tok/s/chip north star scales ~inversely with model size). Configs
+# without an entry report vs_baseline: null rather than a misleading ratio.
+BASELINES = {
+    "llama2-7b": 1250.0,
+    "llama2-13b": 675.0,
+    "llama2-70b": 125.0,
+    "debug-1b": 8000.0,
+}
 
 
 def random_quantized_params(cfg: llama.LlamaConfig, key: jax.Array):
@@ -91,13 +99,14 @@ def main(
     dt = time.perf_counter() - t0
 
     tok_s = batch * steps / dt
+    baseline = BASELINES.get(config)
     print(
         json.dumps(
             {
                 "metric": f"{config.replace('-', '_')}_int8_decode_throughput_per_chip",
                 "value": round(tok_s, 1),
                 "unit": "tokens/sec/chip",
-                "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
+                "vs_baseline": round(tok_s / baseline, 3) if baseline else None,
             }
         )
     )
@@ -105,12 +114,54 @@ def main(
 
 if __name__ == "__main__":
     import argparse
+    import sys
+    import traceback
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--cache-len", type=int, default=512)
     ap.add_argument("--steps", type=int, default=64)
-    ap.add_argument("--config", default="llama2-7b")
+    ap.add_argument(
+        "--config", default="llama2-7b", choices=sorted(llama.CONFIGS)
+    )
     ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
+    ap.add_argument(
+        "--no-fallback", action="store_true",
+        help="fail instead of retrying smaller tiers",
+    )
     a = ap.parse_args()
-    main(a.batch, a.cache_len, a.steps, a.config, a.kv_dtype)
+
+    def is_oom(e: BaseException) -> bool:
+        text = f"{type(e).__name__}: {e}"
+        return any(
+            marker in text
+            for marker in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+                           "exceeds the memory")
+        )
+
+    # Fallback ladder: an out-of-memory on the headline config retries
+    # smaller batches, then a smaller model, so a hardware run always lands
+    # a number. Non-OOM errors fail fast.
+    tiers = [
+        (a.batch, a.cache_len, a.config),
+        (max(1, a.batch // 2), a.cache_len, a.config),
+        (max(1, a.batch // 4), max(256, a.cache_len // 2), a.config),
+        (8, 512, "debug-1b"),
+    ]
+    if a.no_fallback:
+        tiers = tiers[:1]
+    seen = set()
+    tiers = [t for t in tiers if not (t in seen or seen.add(t))]
+    for i, (batch, cache_len, config) in enumerate(tiers):
+        try:
+            main(batch, cache_len, a.steps, config, a.kv_dtype)
+            break
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            if i == len(tiers) - 1 or not is_oom(e):
+                raise
+            print(
+                f"bench tier (batch={batch}, cache={cache_len}, "
+                f"config={config}) hit OOM; retrying smaller",
+                file=sys.stderr,
+            )

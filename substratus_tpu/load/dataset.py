@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     if os.path.exists(args.params):
         with open(args.params) as f:
             p = json.load(f)
+    from substratus_tpu.utils.params import warn_unknown_keys
+
+    warn_unknown_keys(p, ("urls", "files"), "load.dataset")
     os.makedirs(args.out, exist_ok=True)
 
     n = 0

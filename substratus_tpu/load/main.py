@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     if os.path.exists(args.params):
         with open(args.params) as f:
             p = json.load(f)
+    from substratus_tpu.utils.params import warn_unknown_keys
+
+    warn_unknown_keys(
+        p, ("name", "config", "quantize", "seed"), "load.main"
+    )
     name = args.name or p.get("name")
 
     from substratus_tpu.models import llama

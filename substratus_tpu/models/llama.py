@@ -256,19 +256,24 @@ def _self_attention(
         from substratus_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, True)
-    if cfg.attn_impl == "ring":
+    if cfg.attn_impl in ("ring", "ulysses"):
         from jax.sharding import PartitionSpec as P
 
-        from substratus_tpu.ops.ring_attention import ring_attention
+        if cfg.attn_impl == "ring":
+            from substratus_tpu.ops.ring_attention import ring_attention as fn
+        else:
+            from substratus_tpu.ops.ulysses_attention import (
+                ulysses_attention as fn,
+            )
 
         spec = P(None, "sequence", None, None)
-        ring = jax.shard_map(
-            lambda q, k, v: ring_attention(q, k, v, axis_name="sequence"),
+        sharded = jax.shard_map(
+            lambda q, k, v: fn(q, k, v, axis_name="sequence"),
             in_specs=(spec, spec, spec),
             out_specs=spec,
             axis_names={"sequence"},
         )
-        return ring(q, k, v)
+        return sharded(q, k, v)
     return dot_product_attention(q, k, v, causal=True, q_positions=positions)
 
 

@@ -1,0 +1,53 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The second context-parallel strategy (SURVEY.md §5 names it as the
+DCN-friendly alternative to ring attention): instead of rotating k/v around
+a ring (n-1 sequential neighbor hops riding ICI), each device trades its
+sequence shard for a head shard with ONE all-to-all, computes full-sequence
+attention on H/n heads locally, and trades back. Two collectives total,
+each a single balanced all-to-all — the right shape when the sequence axis
+spans DCN (multi-slice) where ring latency would serialize n-1 hops.
+
+Trade-off vs ring: requires n_heads (and kv heads for the k/v scatter)
+divisible by the axis size, and peak activation holds the full sequence for
+its head shard — ring holds only S/n but needs n steps.
+
+Runs under shard_map with q/k/v sharded on the sequence dim, like
+ops/ring_attention.py; dispatched via cfg.attn_impl == "ulysses".
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from substratus_tpu.ops.attention import dot_product_attention
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, S/n, H, D] local sequence shard
+    k: jnp.ndarray,  # [B, S/n, KH, D]
+    v: jnp.ndarray,  # [B, S/n, KH, D]
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    n = lax.psum(1, axis_name)
+    h, kh = q.shape[2], k.shape[2]
+    if h % n or kh % n:
+        raise ValueError(
+            f"ulysses needs heads divisible by the sequence axis: "
+            f"H={h}, KH={kh}, axis={n}"
+        )
+
+    # Scatter heads, gather sequence: [B, S/n, H, D] -> [B, S, H/n, D].
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    out = dot_product_attention(q, k, v, causal=causal, scale=scale)
+
+    # Gather heads, scatter sequence back: [B, S, H/n, D] -> [B, S/n, H, D].
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

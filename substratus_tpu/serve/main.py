@@ -51,6 +51,17 @@ def main(argv=None) -> int:
     maybe_initialize()
 
     params_json = load_params_json()
+    from substratus_tpu.utils.params import warn_unknown_keys
+
+    warn_unknown_keys(
+        params_json,
+        (
+            "model", "config", "quantize", "max_batch", "max_seq_len",
+            "max_prefill_len", "kv_cache_dtype", "attn_impl", "tensor",
+            "replicas",
+        ),
+        "serve.main",
+    )
     model_dir = args.model or params_json.get("model") or (
         "/content/model" if os.path.isdir("/content/model") else None
     )

@@ -42,6 +42,20 @@ def main(argv=None) -> int:
         with open(args.params) as f:
             p = json.load(f)
 
+    from substratus_tpu.utils.params import warn_unknown_keys
+
+    warn_unknown_keys(
+        p,
+        (
+            "steps", "max_steps", "batch_size", "seq_len", "learning_rate",
+            "warmup_steps", "save_steps", "lora_rank", "lora_alpha",
+            "quantize", "config", "dp", "fsdp", "sequence", "tensor",
+            "remat", "seed", "grad_accum_steps", "profile_steps",
+            "attn_impl",
+        ),
+        "train.main",
+    )
+
     from substratus_tpu.models import llama
     from substratus_tpu.parallel.mesh import build_mesh
     from substratus_tpu.serve.tokenizer import load_tokenizer
@@ -94,13 +108,27 @@ def main(argv=None) -> int:
         tensor=int(p.get("tensor", 1)),
     )
     dp_total = mesh.shape["data"] * mesh.shape["fsdp"]
-    if batch_size % dp_total:
-        batch_size = ((batch_size // dp_total) + 1) * dp_total
+    accum = max(1, int(p.get("grad_accum_steps", 1)))
+    # Each of the `accum` microbatches must itself split over data*fsdp.
+    unit = dp_total * accum
+    if batch_size % unit:
+        batch_size = ((batch_size // unit) + 1) * unit
         print(
             f"batch_size rounded up to {batch_size} "
-            f"(multiple of data*fsdp={dp_total})",
+            f"(multiple of data*fsdp*grad_accum_steps={unit})",
             flush=True,
         )
+    # Context parallelism: {"sequence": N, "attn_impl": "ring"|"ulysses"}
+    # shards attention over the sequence axis (llama family).
+    attn_impl = p.get("attn_impl")
+    if attn_impl is not None:
+        if attn_impl not in ("xla", "flash", "ring", "ulysses"):
+            raise SystemExit(f"unknown attn_impl {attn_impl!r}")
+        if hasattr(cfg, "attn_impl"):
+            cfg = cfg.replace(attn_impl=attn_impl)
+        else:
+            print(f"attn_impl ignored for the {type(cfg).__name__} family")
+
     tc = TrainConfig(
         learning_rate=float(p.get("learning_rate", 2e-5)),
         warmup_steps=int(p.get("warmup_steps", min(10, steps // 10 + 1))),
@@ -177,14 +205,14 @@ def main(argv=None) -> int:
         if step % 10 == 0 or step == steps - 1:
             dt = time.time() - t0
             print(f"step {step} loss {loss:.4f} ({dt:.1f}s)", flush=True)
-    if tracing:
-        jax.profiler.stop_trace()
         trainable = trainer.lora if trainer.lora is not None else trainer.params
         ckpt.maybe_save(
             step + 1,
             {"trainable": trainable, "opt_state": trainer.opt_state},
             force=(step == steps - 1),
         )
+    if tracing:
+        jax.profiler.stop_trace()
     ckpt.close()
 
     final = (

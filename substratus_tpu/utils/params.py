@@ -1,0 +1,24 @@
+"""params.json hygiene for the container contract.
+
+Twice this codebase accepted a documented params key and silently ignored it
+(grad_accum_steps, max_prefill_len). Entrypoints now declare the keys they
+consume and warn loudly about anything else — a typo'd knob should be a
+visible warning, never a silent no-op.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterable
+
+
+def warn_unknown_keys(
+    params: Dict, known: Iterable[str], where: str
+) -> None:
+    unknown = sorted(set(params) - set(known))
+    if unknown:
+        print(
+            f"warning: {where} ignores unrecognized params.json keys "
+            f"{unknown} (typo? known keys: {sorted(set(known))})",
+            file=sys.stderr,
+            flush=True,
+        )

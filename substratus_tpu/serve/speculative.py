@@ -1,0 +1,148 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Decode is HBM-bandwidth-bound — each target step streams every weight for
+one token. Speculation amortizes that stream: the draft greedily proposes k
+tokens (k cheap steps), then ONE target forward scores all k+1 positions;
+the longest prefix where the target's greedy choice matches the proposal is
+accepted, plus the target's own next token as a bonus. Greedy acceptance
+makes the output token-for-token identical to plain target greedy decoding —
+speculation is a pure latency/throughput trade, never a quality one.
+
+Cache correctness note: verification writes draft-proposed k/v at positions
+beyond the accepted prefix. Those slots are harmless-then-overwritten: the
+causal mask (q_positions) never reads a slot beyond the current query
+position, and the next round rewrites exactly those positions with the
+accepted tokens.
+
+Per-request API (llama-family); engine-integrated batched speculation is a
+future round.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from substratus_tpu.models import llama
+from substratus_tpu.models.llama import LlamaConfig, Params
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"), donate_argnames=("cache",))
+def _propose(params, cache, token, pos, cfg, k):
+    """Draft k greedy tokens; returns (proposal [k], updated cache)."""
+
+    def step(carry, _):
+        cache, token, pos = carry
+        logits, cache = llama.forward(
+            params, token[:, None], cfg, positions=pos[:, None], cache=cache
+        )
+        nxt = logits[:, 0].argmax(-1).astype(jnp.int32)
+        return (cache, nxt, pos + 1), nxt[0]
+
+    (cache, _, _), proposal = jax.lax.scan(
+        step, (cache, token, pos), None, length=k
+    )
+    return proposal, cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _verify(params, cache, tokens, pos0, cfg):
+    """One target forward over [last_accepted, d1..dk]; returns the greedy
+    choice at every position [k+1] and the updated cache."""
+    b, s = 1, tokens.shape[0]
+    positions = pos0 + jnp.arange(s, dtype=jnp.int32)[None, :]
+    logits, cache = llama.forward(
+        params, tokens[None, :], cfg, positions=positions, cache=cache
+    )
+    return logits[0].argmax(-1).astype(jnp.int32), cache
+
+
+def speculative_generate(
+    target_params: Params,
+    target_cfg: LlamaConfig,
+    draft_params: Params,
+    draft_cfg: LlamaConfig,
+    prompt_tokens: List[int],
+    max_tokens: int = 64,
+    k: int = 4,
+    eos_token_id: int = -1,
+    cache_len: int = 1024,
+) -> Tuple[List[int], dict]:
+    """Greedy generation from the target model, accelerated by the draft.
+
+    Returns (tokens, stats) where stats counts target forward passes vs
+    tokens produced (the speedup ratio decode would see).
+    """
+    prompt = jnp.asarray([prompt_tokens], jnp.int32)
+    n_prompt = len(prompt_tokens)
+
+    t_cache = llama.init_cache(target_cfg, 1, cache_len)
+    d_cache = llama.init_cache(draft_cfg, 1, cache_len)
+    t_logits, t_kv = llama.forward(target_params, prompt, target_cfg)
+    _, d_kv = llama.forward(draft_params, prompt, draft_cfg)
+    for cache, kv in ((t_cache, t_kv), (d_cache, d_kv)):
+        cache["k"] = cache["k"].at[:, :, :n_prompt].set(kv["k"])
+        cache["v"] = cache["v"].at[:, :, :n_prompt].set(kv["v"])
+
+    out: List[int] = []
+    last = int(t_logits[0, -1].argmax())
+    out.append(last)
+    pos = n_prompt  # next position to write for both models
+    target_passes = 1
+
+    while len(out) < max_tokens and out[-1] != eos_token_id:
+        # Verify writes positions pos..pos+step_k; the last slot is
+        # cache_len-1, so step_k may reach cache_len - 1 - pos.
+        step_k = min(k, max_tokens - len(out), cache_len - 1 - pos)
+        if step_k < 1:
+            break
+        proposal, d_cache = _propose(
+            draft_params, d_cache,
+            jnp.asarray([last], jnp.int32),
+            jnp.asarray([pos], jnp.int32),
+            draft_cfg, step_k,
+        )
+        block = jnp.concatenate(
+            [jnp.asarray([last], jnp.int32), proposal]
+        )  # [step_k + 1]
+        choices, t_cache = _verify(
+            target_params, t_cache, block, jnp.asarray([pos], jnp.int32),
+            target_cfg,
+        )
+        target_passes += 1
+
+        proposal_host = [int(x) for x in proposal]
+        choices_host = [int(x) for x in choices]
+        accepted = 0
+        while (
+            accepted < step_k
+            and proposal_host[accepted] == choices_host[accepted]
+        ):
+            accepted += 1
+        if accepted == step_k:
+            # Full acceptance: no bonus token — the draft never wrote the
+            # last proposal's kv, so it must be the next round's `last`
+            # (both caches then stay hole-free).
+            new_tokens = proposal_host
+            pos += accepted
+        else:
+            # Partial: accepted draft tokens + the target's correction.
+            new_tokens = proposal_host[:accepted] + [choices_host[accepted]]
+            pos += accepted + 1
+        for tok in new_tokens:
+            out.append(tok)
+            if tok == eos_token_id or len(out) >= max_tokens:
+                break
+        last = out[-1]
+        # Stale cache rows beyond `pos` (rejected drafts) are never read:
+        # the causal mask stops at the query position and the next round
+        # rewrites exactly those slots.
+
+    stats = {
+        "tokens": len(out),
+        "target_passes": target_passes,
+        "tokens_per_target_pass": round(len(out) / max(1, target_passes), 2),
+    }
+    return out, stats

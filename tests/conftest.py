@@ -27,6 +27,32 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def greedy_decode(module, params, cfg, prompt, max_tokens, cache_len=256):
+    """Shared greedy-decode oracle: prefill, seed the cache, step. The one
+    reference implementation of the cache-seeding contract for tests.
+    (test_serve/test_int8_kv compare per-step logits and keep their own
+    step loops.)"""
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray([prompt], jnp.int32)
+    logits, kv = module.forward(params, tokens, cfg)
+    cache = module.init_cache(cfg, 1, cache_len)
+    n = len(prompt)
+    cache["k"] = cache["k"].at[:, :, :n].set(kv["k"])
+    cache["v"] = cache["v"].at[:, :, :n].set(kv["v"])
+    out = [int(logits[0, -1].argmax())]
+    pos = n
+    while len(out) < max_tokens:
+        lg, cache = module.decode_step(
+            params, cache,
+            jnp.asarray([out[-1]], jnp.int32),
+            jnp.asarray([pos], jnp.int32), cfg,
+        )
+        out.append(int(lg[0].argmax()))
+        pos += 1
+    return out
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     """A 2x2x2 (data, fsdp, tensor) mesh over 8 virtual CPU devices."""

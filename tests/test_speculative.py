@@ -1,0 +1,45 @@
+"""Speculative decoding must be token-for-token identical to plain target
+greedy decoding — speculation is a schedule, not a sampler."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from conftest import greedy_decode
+from substratus_tpu.models import llama
+from substratus_tpu.serve.speculative import speculative_generate
+
+
+def _plain_greedy(params, cfg, prompt, max_tokens):
+    return greedy_decode(llama, params, cfg, prompt, max_tokens)
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_matches_plain_greedy(k):
+    cfg_t = llama.CONFIGS["tiny"].replace(dtype=jnp.float32)
+    target = llama.init_params(cfg_t, jax.random.key(0))
+    # Draft: same arch, different weights (worst case: low acceptance) —
+    # output must STILL match the target exactly.
+    cfg_d = cfg_t.replace(n_layers=1)
+    draft = llama.init_params(cfg_d, jax.random.key(9))
+
+    prompt = [1, 7, 42, 99]
+    want = _plain_greedy(target, cfg_t, prompt, 16)
+    got, stats = speculative_generate(
+        target, cfg_t, draft, cfg_d, prompt, max_tokens=16, k=k, cache_len=256
+    )
+    assert got == want, (got, want, stats)
+    assert stats["tokens"] == 16
+
+
+def test_speculative_self_draft_max_acceptance():
+    """Draft == target: every proposal accepted; target passes ~tokens/k."""
+    cfg = llama.CONFIGS["tiny"].replace(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = [1, 2, 3]
+    want = _plain_greedy(params, cfg, prompt, 17)
+    got, stats = speculative_generate(
+        params, cfg, params, cfg, prompt, max_tokens=17, k=4, cache_len=256
+    )
+    assert got == want, (got, want)
+    # Perfect acceptance: ~4 tokens per target pass (plus prefill).
+    assert stats["tokens_per_target_pass"] >= 3.0, stats

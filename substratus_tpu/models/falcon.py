@@ -29,9 +29,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from substratus_tpu.ops.attention import dot_product_attention
-from substratus_tpu.ops.basics import layer_norm, rope
+from substratus_tpu.ops.basics import layer_norm, rope, lora_delta
 
 Params = Dict[str, Any]
+
+# train/lora.py adapters attach to the attention projections (wq/wk/wv/wo).
+SUPPORTS_LORA = True
 
 
 @dataclass(frozen=True)
@@ -145,16 +148,25 @@ def cache_logical_axes(cfg: FalconConfig, quantized: bool = False) -> Params:
     return {"k": ax, "v": ax}
 
 
-def _block(x, lp, positions, cfg, layer_cache, kv_length=None):
+def _block(x, lp, positions, cfg, layer_cache, kv_length=None,
+           lora_layers=None, lora_scale=1.0):
+    lora = lora_layers or {}
     h_attn = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
     h_mlp = (
         layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
         if cfg.separate_ln
         else h_attn
     )
-    q = jnp.einsum("bsd,dhk->bshk", h_attn, lp["wq"])
-    kk = jnp.einsum("bsd,dhk->bshk", h_attn, lp["wk"])
-    vv = jnp.einsum("bsd,dhk->bshk", h_attn, lp["wv"])
+
+    def proj(name, eq, lora_eq):
+        out = jnp.einsum(eq, h_attn, lp[name])
+        if name in lora:
+            out = out + lora_delta(h_attn, lora[name], lora_scale, lora_eq)
+        return out
+
+    q = proj("wq", "bsd,dhk->bshk", "bsr,rhk->bshk")
+    kk = proj("wk", "bsd,dhk->bshk", "bsr,rhk->bshk")
+    vv = proj("wv", "bsd,dhk->bshk", "bsr,rhk->bshk")
     q = rope(q, positions, cfg.rope_theta)
     kk = rope(kk, positions, cfg.rope_theta)
 
@@ -176,6 +188,11 @@ def _block(x, lp, positions, cfg, layer_cache, kv_length=None):
         kv_out = {"k": k_cache, "v": v_cache}
 
     attn_out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    if "wo" in lora:
+        b, s = x.shape[:2]
+        attn_out = attn_out + lora_delta(
+            attn.reshape(b, s, -1), lora["wo"], lora_scale, "bsr,rd->bsd"
+        )
     mlp_out = jnp.einsum(
         "bsm,md->bsd",
         jax.nn.gelu(jnp.einsum("bsd,dm->bsm", h_mlp, lp["fc1"]), approximate=False),
@@ -193,28 +210,30 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
     cache: Optional[Params] = None,
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
-    lora=None,  # not implemented for this family: rejected loudly
+    lora: Optional[Params] = None,  # {"layers": adapters, "scale": s}
     remat: bool = False,
     train: bool = False,
 ) -> Tuple[jnp.ndarray, Params]:
-    if lora is not None:
-        raise NotImplementedError("LoRA adapters not implemented for falcon")
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
     x = params["tok_embed"][tokens]
 
+    lora_scale = lora["scale"] if lora is not None else 1.0
+
     def body(carry, layer_in):
         x_out, kv = _block(
             carry, layer_in["lp"], positions, cfg, layer_in.get("cache"),
-            kv_length,
+            kv_length, layer_in.get("lora"), lora_scale,
         )
         return x_out, kv
 
     xs: Dict[str, Any] = {"lp": params["layers"]}
     if cache is not None:
         xs["cache"] = cache
+    if lora is not None:
+        xs["lora"] = lora["layers"]
     if remat:
         body = jax.checkpoint(body)
     x, kv = lax.scan(body, x, xs)

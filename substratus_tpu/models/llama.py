@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from substratus_tpu.ops.attention import dot_product_attention
-from substratus_tpu.ops.basics import rms_norm, rope, swiglu
+from substratus_tpu.ops.basics import rms_norm, rope, swiglu, lora_delta
 from substratus_tpu.ops.quant import materialize
 
 Params = Dict[str, Any]
@@ -361,14 +361,6 @@ def _moe_ffn(
     return y.astype(dt), aux
 
 
-def _lora_delta(
-    h: jnp.ndarray, adapter, scale, out_einsum: str
-) -> jnp.ndarray:
-    """h @ A @ B * scale (LoRA low-rank update; train/lora.py owns init)."""
-    down = jnp.einsum("bsd,dr->bsr", h, adapter["a"])
-    return jnp.einsum(out_einsum, down, adapter["b"]) * scale
-
-
 def _block(
     x: jnp.ndarray,  # [B, S, D]
     lp: Params,  # single-layer params (leading L axis removed by scan)
@@ -391,7 +383,7 @@ def _block(
     def proj(name: str, inp: jnp.ndarray, eq: str, lora_eq: str) -> jnp.ndarray:
         out = jnp.einsum(eq, inp, materialize(lp[name], dt))
         if name in lora:
-            out = out + _lora_delta(inp, lora[name], lora_scale, lora_eq)
+            out = out + lora_delta(inp, lora[name], lora_scale, lora_eq)
         return out
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -443,7 +435,7 @@ def _block(
     attn_flat = attn.reshape(b, s, -1)
     o = jnp.einsum("bshk,hkd->bsd", attn, materialize(lp["wo"], dt))
     if "wo" in lora:
-        o = o + _lora_delta(attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd")
+        o = o + lora_delta(attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd")
     x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:

@@ -24,11 +24,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from substratus_tpu.ops.attention import dot_product_attention
-from substratus_tpu.ops.basics import layer_norm
+from substratus_tpu.ops.basics import layer_norm, lora_delta
 
 Params = Dict[str, Any]
 
 POS_OFFSET = 2  # OPT reserves the first two position-embedding rows.
+
+# train/lora.py adapters attach to the attention projections (wq/wk/wv/wo).
+SUPPORTS_LORA = True
 
 
 @dataclass(frozen=True)
@@ -144,11 +147,20 @@ def cache_logical_axes(cfg: OPTConfig, quantized: bool = False) -> Params:
     return {"k": ax, "v": ax}
 
 
-def _block(x, lp, positions, cfg, layer_cache, kv_length=None):
+def _block(x, lp, positions, cfg, layer_cache, kv_length=None,
+           lora_layers=None, lora_scale=1.0):
+    lora = lora_layers or {}
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"]) + lp["bq"]
-    kk = jnp.einsum("bsd,dhk->bshk", h, lp["wk"]) + lp["bk"]
-    vv = jnp.einsum("bsd,dhk->bshk", h, lp["wv"]) + lp["bv"]
+
+    def proj(name, bias, eq, lora_eq):
+        out = jnp.einsum(eq, h, lp[name]) + lp[bias]
+        if name in lora:
+            out = out + lora_delta(h, lora[name], lora_scale, lora_eq)
+        return out
+
+    q = proj("wq", "bq", "bsd,dhk->bshk", "bsr,rhk->bshk")
+    kk = proj("wk", "bk", "bsd,dhk->bshk", "bsr,rhk->bshk")
+    vv = proj("wv", "bv", "bsd,dhk->bshk", "bsr,rhk->bshk")
 
     if layer_cache is None:
         attn = dot_product_attention(q, kk, vv, causal=True, q_positions=positions)
@@ -164,7 +176,13 @@ def _block(x, lp, positions, cfg, layer_cache, kv_length=None):
         )
         kv_out = (k_cache, v_cache)
 
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]) + lp["bo"]
+    o = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]) + lp["bo"]
+    if "wo" in lora:
+        b, s = x.shape[:2]
+        o = o + lora_delta(
+            attn.reshape(b, s, -1), lora["wo"], lora_scale, "bsr,rd->bsd"
+        )
+    x = x + o
     h = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
     h = jax.nn.relu(jnp.einsum("bsd,dm->bsm", h, lp["fc1"]) + lp["fc1_b"])
     x = x + jnp.einsum("bsm,md->bsd", h, lp["fc2"]) + lp["fc2_b"]
@@ -179,28 +197,31 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
     cache: Optional[Params] = None,
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix
-    lora=None,  # not implemented for this family: rejected loudly
+    lora: Optional[Params] = None,  # {"layers": adapters, "scale": s}
     remat: bool = False,
     train: bool = False,
 ) -> Tuple[jnp.ndarray, Params]:
-    if lora is not None:
-        raise NotImplementedError("LoRA adapters not implemented for opt")
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
     x = params["tok_embed"][tokens] + params["pos_embed"][positions + POS_OFFSET]
 
+    lora_scale = lora["scale"] if lora is not None else 1.0
+
     def body(carry, layer_in):
         lp = layer_in["lp"]
         x_out, kv = _block(
-            carry, lp, positions, cfg, layer_in.get("cache"), kv_length
+            carry, lp, positions, cfg, layer_in.get("cache"), kv_length,
+            layer_in.get("lora"), lora_scale,
         )
         return x_out, kv
 
     xs: Dict[str, Any] = {"lp": params["layers"]}
     if cache is not None:
         xs["cache"] = (cache["k"], cache["v"])
+    if lora is not None:
+        xs["lora"] = lora["layers"]
     if remat:
         body = jax.checkpoint(body)
     x, (ks, vs) = lax.scan(body, x, xs)

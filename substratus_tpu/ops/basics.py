@@ -63,3 +63,10 @@ def layer_norm(
     return (
         normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)
     ).astype(dtype)
+
+
+def lora_delta(h, adapter, scale, out_einsum: str):
+    """LoRA low-rank update h @ A @ B * scale; shared by every model family
+    (adapter trees come from train/lora.py)."""
+    down = jnp.einsum("bsd,dr->bsr", h, adapter["a"])
+    return jnp.einsum(out_einsum, down, adapter["b"]) * scale

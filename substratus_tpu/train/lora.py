@@ -1,4 +1,4 @@
-"""LoRA adapters for the Llama family.
+"""LoRA adapters for the model families (llama, falcon, opt).
 
 The reference's finetuning ran inside `substratusai/model-trainer-huggingface`
 (SURVEY.md §2.2, examples/llama2-7b/finetuned-model.yaml) using HF PEFT-style
@@ -14,7 +14,6 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from substratus_tpu.models.llama import LlamaConfig
 
 LoraParams = Dict[str, Any]
 
@@ -23,7 +22,7 @@ DEFAULT_TARGETS = ("wq", "wv")
 
 
 def init_lora(
-    cfg: LlamaConfig,
+    cfg,  # any family config with dim/n_heads/n_kv_heads/head_size/hidden_dim
     key: jax.Array,
     rank: int = 8,
     alpha: float = 16.0,
@@ -48,7 +47,7 @@ def init_lora(
         "w_gate": cfg.dim, "w_up": cfg.dim,
         "w_down": cfg.hidden_dim,
     }
-    if cfg.n_experts > 0:
+    if getattr(cfg, "n_experts", 0) > 0:
         moe_mlp = {"w_gate", "w_up", "w_down"} & set(targets)
         if moe_mlp:
             raise ValueError(
@@ -81,11 +80,7 @@ def merge_lora(
     Returns a dense params tree (quantized bases are dequantized first) ready
     for save_artifact/serving without adapter plumbing.
     """
-    import jax.numpy as jnp
-
-    from substratus_tpu.ops.quant import materialize
-
-    from substratus_tpu.ops.quant import QTensor
+    from substratus_tpu.ops.quant import QTensor, materialize
 
     out = dict(params)
     layers = dict(params["layers"])

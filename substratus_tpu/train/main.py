@@ -87,7 +87,9 @@ def main(argv=None) -> int:
             cfg, params = load_pretrained(model_dir)
         tokenizer = load_tokenizer(model_dir)
     else:
-        cfg = llama.CONFIGS[p.get("config", "tiny")]
+        from substratus_tpu.models import registry
+
+        _, cfg = registry.find_named_config(p.get("config", "tiny"))
         tokenizer = load_tokenizer(None)
         if cfg.vocab_size < tokenizer.vocab_size:
             cfg = cfg.replace(vocab_size=tokenizer.vocab_size)

@@ -34,8 +34,8 @@ from substratus_tpu.models.llama import LlamaConfig, Params
 from substratus_tpu.parallel.sharding import (
     DEFAULT_RULES,
     LogicalRules,
-    logical_sharding,
     shard_tree,
+    sharding_tree,
 )
 from substratus_tpu.train import lora as lora_lib
 
@@ -123,8 +123,6 @@ class Trainer:
         self.optimizer = make_optimizer(tc)
         key_params, key_lora = jax.random.split(jax.random.key(tc.seed))
 
-        from substratus_tpu.parallel.sharding import sharding_tree
-
         # sharding_tree (not logical_sharding): it sees the shapes, so
         # non-divisible dims (e.g. MQA's single kv head vs a tensor axis)
         # fall back to replication instead of erroring.
@@ -158,8 +156,10 @@ class Trainer:
                 cfg, key_lora, rank=tc.lora_rank, alpha=tc.lora_alpha
             )
             self.lora_scale = tc.lora_alpha / tc.lora_rank
-            self.lora_shardings = logical_sharding(
-                mesh, lora_lib.lora_logical_axes(adapters), rules
+            # Shape-aware (like params): MQA kv adapters replicate rather
+            # than error when kv_heads doesn't divide the tensor axis.
+            self.lora_shardings = sharding_tree(
+                adapters, mesh, lora_lib.lora_logical_axes(adapters), rules
             )
             self.lora = jax.tree.map(
                 jax.device_put, adapters, self.lora_shardings

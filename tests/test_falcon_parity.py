@@ -106,8 +106,21 @@ def test_falcon_trains_via_generic_trainer():
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
 
-    # LoRA on falcon is rejected loudly, not silently ignored.
-    import pytest
-
-    with pytest.raises(NotImplementedError, match="LoRA"):
-        Trainer(cfg, TrainConfig(lora_rank=4), mesh)
+    # LoRA adapters train on falcon too (attention projections), with the
+    # base frozen.
+    lora_trainer = Trainer(
+        cfg,
+        TrainConfig(learning_rate=5e-3, lora_rank=4, total_steps=10,
+                    warmup_steps=2, remat=False),
+        mesh,
+        params=trainer.params,
+    )
+    base_before = jax.tree.map(lambda x: np.asarray(x), lora_trainer.params)
+    lora_losses = [lora_trainer.train_step(batch) for _ in range(5)]
+    assert np.isfinite(lora_losses).all()
+    assert lora_losses[-1] < lora_losses[0], lora_losses
+    for a, b in zip(
+        jax.tree.leaves(base_before),
+        jax.tree.leaves(jax.tree.map(lambda x: np.asarray(x), lora_trainer.params)),
+    ):
+        np.testing.assert_array_equal(a, b)

@@ -64,6 +64,33 @@ def test_opt_decode_matches_forward():
         )
 
 
+def test_opt_lora_trains(mesh8):
+    """OPT attention-projection adapters train with the base frozen."""
+    from substratus_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = opt.CONFIGS["tiny-opt"].replace(dtype=jnp.float32)
+    trainer = Trainer(
+        cfg,
+        TrainConfig(learning_rate=5e-3, lora_rank=4, total_steps=10,
+                    warmup_steps=2, remat=False),
+        mesh8,
+    )
+    base_before = jax.tree.map(lambda x: np.asarray(x), trainer.params)
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, cfg.vocab_size, size=(4, 32)).astype(np.int32),
+        "weights": np.ones((4, 32), np.float32),
+    }
+    losses = [trainer.train_step(batch) for _ in range(5)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    for a, b in zip(
+        jax.tree.leaves(base_before),
+        jax.tree.leaves(jax.tree.map(lambda x: np.asarray(x), trainer.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_engine_serves_opt():
     from substratus_tpu.serve.engine import Engine, EngineConfig
 

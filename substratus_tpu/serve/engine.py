@@ -282,8 +282,26 @@ class Engine:
             self._thread.join(timeout=30)
 
     def _admit(self):
-        """Fill free slots from the request queue (prefill + insert)."""
-        while not self.queue.empty() and not self.active.all():
+        """Fill free slots from the request queue (prefill + insert).
+
+        Admission is capped per scheduler iteration so a burst of arrivals
+        can't starve in-flight decodes: each loop admits a few prefills,
+        then every active slot advances a token."""
+        admitted = 0
+        # No in-flight decodes -> nothing to starve: fill freely (decode
+        # steps cost the same at any occupancy, so boarding everyone first
+        # is strictly better for TTFT).
+        cap = (
+            max(1, self.ec.max_batch // 4)
+            if self.active.any()
+            else self.ec.max_batch
+        )
+        while (
+            admitted < cap
+            and not self.queue.empty()
+            and not self.active.all()
+        ):
+            admitted += 1
             try:
                 req = self.queue.get_nowait()
             except queue.Empty:

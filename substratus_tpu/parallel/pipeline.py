@@ -48,15 +48,16 @@ def stage_params(params: Params, n_stages: int) -> Params:
     return out
 
 
-def _stage_fn(local_layers: Params, x: jnp.ndarray, positions, cfg) -> jnp.ndarray:
-    """Apply this stage's local layer stack (scan over layers)."""
+def _stage_fn(local_layers: Params, x: jnp.ndarray, positions, cfg, train):
+    """Apply this stage's local layer stack (scan over layers). Returns
+    (x_out, summed MoE aux for the stage — 0 for dense models)."""
 
     def body(carry, lp):
-        x_out, _, _ = llama._block(carry, lp, positions, cfg, None)
-        return x_out, None
+        x_out, _, aux = llama._block(carry, lp, positions, cfg, None, train=train)
+        return x_out, aux
 
-    x, _ = lax.scan(body, x, local_layers)
-    return x
+    x, auxes = lax.scan(body, x, local_layers)
+    return x, auxes.sum()
 
 
 def pipeline_forward(
@@ -65,17 +66,14 @@ def pipeline_forward(
     cfg: LlamaConfig,
     n_stages: int,
     n_microbatches: int,
-) -> jnp.ndarray:
-    """Pipelined logits [B, S, vocab]. Call inside jit with an ambient mesh
-    (jax.set_mesh) that has a "stage" axis of size n_stages."""
+    train: bool = False,
+):
+    """Pipelined (logits [B, S, vocab], moe_aux scalar). Call inside jit
+    with an ambient mesh (jax.set_mesh) that has a "stage" axis of size
+    n_stages. For MoE models the router load-balancing aux is accumulated
+    across stages and valid microbatches (0.0 for dense models); `train`
+    selects the capacity-dispatch expert path like llama.forward."""
     B, S = tokens.shape
-    if cfg.n_experts > 0:
-        # The stage fn would silently drop the router aux loss and use the
-        # inference expert path; refuse rather than mis-train.
-        raise NotImplementedError(
-            "pipeline parallelism for MoE models is not implemented yet "
-            "(router aux loss must thread through the pipelined region)"
-        )
     if B % n_microbatches:
         raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
     mb = B // n_microbatches
@@ -98,27 +96,35 @@ def pipeline_forward(
             act = carry  # activation arriving from the previous stage
             inject = micro[jnp.clip(t, 0, M - 1)]
             inp = jnp.where(stage == 0, inject, act)
-            out = _stage_fn(local, inp, positions, cfg)
+            out, aux = _stage_fn(local, inp, positions, cfg, train)
+            # This stage processes microbatch t - stage; aux from warmup/
+            # drain steps (garbage inputs) must not count.
+            mb_idx = t - stage
+            valid = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+            aux = jnp.where(valid, aux, 0.0)
             # The last stage's output at step t is microbatch t-(n-1).
             collect = jnp.where(stage == n - 1, out, jnp.zeros_like(out))
             act_next = lax.ppermute(out, AXIS, perm)
-            return act_next, collect
+            return act_next, (collect, aux)
 
         init = jnp.zeros((mb, S, cfg.dim), cfg.dtype)
         # Mark the carry as stage-varying: the scan's output (post-ppermute)
         # is device-varying, and scan requires carry types to match.
         init = lax.pcast(init, (AXIS,), to="varying")
-        _, collected = lax.scan(step, init, jnp.arange(M + n - 1))
+        _, (collected, auxes) = lax.scan(step, init, jnp.arange(M + n - 1))
         # Valid outputs live at steps n-1 .. n-1+M-1; broadcast them off the
         # last stage to every stage (zeros elsewhere -> psum is a select).
         outs = collected[n - 1:]
         outs = lax.psum(outs, AXIS)
-        return outs  # [M, mb, S, D]
+        # Mean aux per (layer, microbatch): sum over stages/steps, then
+        # normalize like llama.forward's kv["moe_aux"].mean().
+        aux_total = lax.psum(auxes.sum(), AXIS) / (cfg.n_layers * M)
+        return outs, aux_total  # [M, mb, S, D], scalar
 
-    outs = jax.shard_map(
+    outs, aux = jax.shard_map(
         pipelined,
         in_specs=(layers_spec, P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={AXIS},
     )(params["layers"], micro)
 
@@ -132,4 +138,4 @@ def pipeline_forward(
         logits = jnp.einsum(
             "bsd,dv->bsv", x, materialize(params["lm_head"], cfg.dtype)
         )
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux

@@ -72,6 +72,35 @@ def test_concurrent_requests(engine):
     assert results == solo, (results, solo)
 
 
+def test_burst_while_decoding(engine):
+    """A burst of arrivals while a request is mid-decode exercises the
+    capped-admission branch; every request must still complete correctly."""
+    prompts = [[256, 40 + i] for i in range(6)]
+    solo = [engine.generate(p, max_tokens=6, temperature=0.0) for p in prompts]
+
+    # Start one long request so the engine is actively decoding, then burst.
+    first = Request(prompt_tokens=[256, 30], max_tokens=24, temperature=0.0)
+    engine.submit(first)
+    while first.out.qsize() == 0:  # wait until it's mid-decode
+        pass
+    reqs = [
+        engine.submit(Request(prompt_tokens=p, max_tokens=6, temperature=0.0))
+        for p in prompts
+    ]
+    results = []
+    for r in reqs:
+        toks = []
+        while True:
+            t = r.out.get(timeout=120)
+            if t is None:
+                break
+            toks.append(t)
+        results.append(toks)
+    while first.out.get(timeout=120) is not None:
+        pass
+    assert results == solo, (results, solo)
+
+
 def test_http_completions(engine):
     """Drive the aiohttp app via its test client."""
     import asyncio

@@ -116,7 +116,26 @@ class FakeKube(KubeClient):
             if key not in self._store:
                 raise NotFound(f"{key} not found")
             obj = self._store.pop(key)
+            # Owner-reference cascade (the garbage collection a real
+            # apiserver performs), transitive: children of deleted objects
+            # are deleted too, worklist over freshly removed uids.
+            orphans = []
+            pending = [obj.get("metadata", {}).get("uid")]
+            while pending:
+                uid = pending.pop()
+                if not uid:
+                    continue
+                for ckey, child in list(self._store.items()):
+                    refs = child.get("metadata", {}).get(
+                        "ownerReferences", []
+                    )
+                    if any(r.get("uid") == uid for r in refs):
+                        gone = self._store.pop(ckey)
+                        orphans.append(gone)
+                        pending.append(gone.get("metadata", {}).get("uid"))
         self._notify("DELETED", obj)
+        for child in orphans:
+            self._notify("DELETED", child)
 
     def add_listener(self, fn: Callable[[str, Obj], None]) -> None:
         self._listeners.append(fn)

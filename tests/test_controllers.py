@@ -247,6 +247,24 @@ def test_build_upload_flow(env):
     assert conds["Built"]["status"] == "True"
 
 
+def test_delete_cascades_to_children(env):
+    """Deleting a CR garbage-collects its owned workloads (ownerReferences,
+    as a real apiserver would)."""
+    client, cloud, sci, mgr = env
+    client.create(_dataset())
+    mgr.run_until_idle()
+    assert client.get_or_none("Job", "default", "squad-data-loader")
+    assert client.get_or_none("ConfigMap", "default", "squad-dataset-params")
+
+    client.delete("Dataset", "default", "squad")
+    mgr.run_until_idle()
+    assert client.get_or_none("Job", "default", "squad-data-loader") is None
+    assert (
+        client.get_or_none("ConfigMap", "default", "squad-dataset-params")
+        is None
+    )
+
+
 def test_secret_env_resolution():
     from substratus_tpu.controller.workloads import resolve_env
 

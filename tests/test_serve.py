@@ -81,8 +81,7 @@ def test_burst_while_decoding(engine):
     # Start one long request so the engine is actively decoding, then burst.
     first = Request(prompt_tokens=[256, 30], max_tokens=24, temperature=0.0)
     engine.submit(first)
-    while first.out.qsize() == 0:  # wait until it's mid-decode
-        pass
+    assert first.out.get(timeout=120) is not None  # it's mid-decode now
     reqs = [
         engine.submit(Request(prompt_tokens=p, max_tokens=6, temperature=0.0))
         for p in prompts

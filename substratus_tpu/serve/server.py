@@ -162,11 +162,30 @@ def build_app(state: ServerState) -> web.Application:
         gen_ids = await _collect(req)
         if state.engine.error is not None:
             raise web.HTTPInternalServerError(text=str(state.engine.error))
-        return (
-            state.tokenizer.decode(gen_ids),
-            len(req.prompt_tokens),
-            len(gen_ids),
-        )
+        text = state.tokenizer.decode(gen_ids)
+        # OpenAI `stop`: truncate at the earliest stop sequence (exclusive),
+        # computed over the ORIGINAL text so the result is order-independent.
+        # Non-streaming only; streamed responses don't hold tokens back.
+        # (Engine-level early stop is a future round — today the slot still
+        # decodes to max_tokens.)
+        stop = body.get("stop")
+        if stop is not None:
+            if isinstance(stop, str):
+                stop = [stop]
+            if not isinstance(stop, list) or not all(
+                isinstance(s, str) for s in stop
+            ):
+                raise web.HTTPBadRequest(
+                    text="'stop' must be a string or list of strings"
+                )
+            cuts = [
+                idx
+                for s in stop
+                if s and (idx := text.find(s)) != -1
+            ]
+            if cuts:
+                text = text[: min(cuts)]
+        return text, len(req.prompt_tokens), len(gen_ids)
 
     async def _stream(
         request: web.Request, prompt: str, body: dict, chat: bool

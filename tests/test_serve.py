@@ -137,6 +137,23 @@ def test_http_completions(engine):
                 },
             )
             assert (await r.json())["object"] == "chat.completion"
+            # stop sequences: the completion truncates at the first match
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hi", "max_tokens": 8, "temperature": 0.0},
+            )
+            full_text = (await r.json())["choices"][0]["text"]
+            if len(full_text) >= 2:
+                r = await client.post(
+                    "/v1/completions",
+                    json={
+                        "prompt": "hi", "max_tokens": 8, "temperature": 0.0,
+                        "stop": full_text[1],
+                    },
+                )
+                stopped = (await r.json())["choices"][0]["text"]
+                assert full_text[1] not in stopped
+                assert full_text.startswith(stopped)
             # observability surface
             r = await client.get("/metrics")
             text = await r.text()

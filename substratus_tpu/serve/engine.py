@@ -57,6 +57,9 @@ class Request:
     # Each generated token id is put on this queue; None marks completion.
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     id: str = ""
+    # Set by the engine before the terminal None: "stop" (eos) or "length"
+    # (max_tokens / context-window cap).
+    finish_reason: str = "stop"
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -369,14 +372,15 @@ class Engine:
         req = self.slot_req[slot]
         eos = req.eos_token_id if req.eos_token_id is not None else self.ec.eos_token_id
         self.slot_generated[slot] += 1
-        done = (
-            token_id == eos
-            or self.slot_generated[slot] >= req.max_tokens
-            or int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
-        )
-        if token_id != eos:
+        hit_eos = token_id == eos
+        hit_budget = self.slot_generated[slot] >= req.max_tokens
+        hit_window = int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
+        if not hit_eos:
             req.out.put(token_id)
-        if done:
+        if hit_eos or hit_budget or hit_window:
+            # eos is a natural stop; running out of budget or context is a
+            # truncation ("length") clients may want to continue from.
+            req.finish_reason = "stop" if hit_eos else "length"
             req.out.put(None)
             self.active[slot] = False
             self.slot_req[slot] = None

@@ -43,14 +43,20 @@ async def _collect(req: Request) -> list[int]:
         out.append(tok)
 
 
-def _completion_body(state: ServerState, text: str, n_prompt: int, n_gen: int):
+def _completion_body(state: ServerState, text: str, n_prompt: int,
+                     n_gen: int, finish_reason: str = "stop"):
     return {
         "id": f"cmpl-{uuid.uuid4().hex[:24]}",
         "object": "text_completion",
         "created": int(time.time()),
         "model": state.model_name,
         "choices": [
-            {"index": 0, "text": text, "finish_reason": "stop", "logprobs": None}
+            {
+                "index": 0,
+                "text": text,
+                "finish_reason": finish_reason,
+                "logprobs": None,
+            }
         ],
         "usage": {
             "prompt_tokens": n_prompt,
@@ -145,6 +151,30 @@ def build_app(state: ServerState) -> web.Application:
             }
         )
 
+    def _validate_body(body: dict) -> None:
+        """Reject malformed request knobs BEFORE any engine work happens
+        (applies to streaming and non-streaming alike)."""
+        stop = body.get("stop")
+        if stop is not None and not (
+            isinstance(stop, str)
+            or (isinstance(stop, list) and all(isinstance(s, str) for s in stop))
+        ):
+            raise web.HTTPBadRequest(
+                text="'stop' must be a string or list of strings"
+            )
+        for key in ("max_tokens",):
+            if key in body:
+                try:
+                    int(body[key])
+                except (TypeError, ValueError):
+                    raise web.HTTPBadRequest(text=f"'{key}' must be an integer")
+        for key in ("temperature", "top_p"):
+            if key in body:
+                try:
+                    float(body[key])
+                except (TypeError, ValueError):
+                    raise web.HTTPBadRequest(text=f"'{key}' must be a number")
+
     def _submit(prompt: str, body: dict) -> Request:
         tok = state.tokenizer
         req = Request(
@@ -172,12 +202,6 @@ def build_app(state: ServerState) -> web.Application:
         if stop is not None:
             if isinstance(stop, str):
                 stop = [stop]
-            if not isinstance(stop, list) or not all(
-                isinstance(s, str) for s in stop
-            ):
-                raise web.HTTPBadRequest(
-                    text="'stop' must be a string or list of strings"
-                )
             cuts = [
                 idx
                 for s in stop
@@ -185,7 +209,9 @@ def build_app(state: ServerState) -> web.Application:
             ]
             if cuts:
                 text = text[: min(cuts)]
-        return text, len(req.prompt_tokens), len(gen_ids)
+                return text, len(req.prompt_tokens), len(gen_ids), "stop"
+        # The engine recorded why generation ended (eos vs budget/window).
+        return text, len(req.prompt_tokens), len(gen_ids), req.finish_reason
 
     async def _stream(
         request: web.Request, prompt: str, body: dict, chat: bool
@@ -239,9 +265,9 @@ def build_app(state: ServerState) -> web.Application:
             if yield_final:
                 break
         done_choice = (
-            {"index": 0, "delta": {}, "finish_reason": "stop"}
+            {"index": 0, "delta": {}, "finish_reason": req.finish_reason}
             if chat
-            else {"index": 0, "text": "", "finish_reason": "stop"}
+            else {"index": 0, "text": "", "finish_reason": req.finish_reason}
         )
         final = {
             "id": cid,
@@ -264,12 +290,17 @@ def build_app(state: ServerState) -> web.Application:
         prompt = body.get("prompt")
         if prompt is None:
             raise web.HTTPBadRequest(text="missing 'prompt'")
+        _validate_body(body)
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
         if body.get("stream"):
             return await _stream(request, str(prompt), body, chat=False)
-        text, n_prompt, n_gen = await _generate(request, str(prompt), body)
-        return web.json_response(_completion_body(state, text, n_prompt, n_gen))
+        text, n_prompt, n_gen, finish = await _generate(
+            request, str(prompt), body
+        )
+        return web.json_response(
+            _completion_body(state, text, n_prompt, n_gen, finish)
+        )
 
     @routes.post("/v1/chat/completions")
     async def chat(request: web.Request) -> web.Response:
@@ -277,6 +308,7 @@ def build_app(state: ServerState) -> web.Application:
             body = await request.json()
         except json.JSONDecodeError:
             raise web.HTTPBadRequest(text="invalid JSON body")
+        _validate_body(body)
         messages = body.get("messages") or []
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
@@ -284,14 +316,14 @@ def build_app(state: ServerState) -> web.Application:
         prompt += "\nassistant:"
         if body.get("stream"):
             return await _stream(request, prompt, body, chat=True)
-        text, n_prompt, n_gen = await _generate(request, prompt, body)
-        resp = _completion_body(state, text, n_prompt, n_gen)
+        text, n_prompt, n_gen, finish = await _generate(request, prompt, body)
+        resp = _completion_body(state, text, n_prompt, n_gen, finish)
         resp["object"] = "chat.completion"
         resp["choices"] = [
             {
                 "index": 0,
                 "message": {"role": "assistant", "content": text},
-                "finish_reason": "stop",
+                "finish_reason": finish,
             }
         ]
         return web.json_response(resp)

@@ -143,17 +143,36 @@ def test_http_completions(engine):
                 json={"prompt": "hi", "max_tokens": 8, "temperature": 0.0},
             )
             full_text = (await r.json())["choices"][0]["text"]
-            if len(full_text) >= 2:
-                r = await client.post(
-                    "/v1/completions",
-                    json={
-                        "prompt": "hi", "max_tokens": 8, "temperature": 0.0,
-                        "stop": full_text[1],
-                    },
-                )
-                stopped = (await r.json())["choices"][0]["text"]
-                assert full_text[1] not in stopped
-                assert full_text.startswith(stopped)
+            assert len(full_text) >= 2, full_text  # precondition, not a guard
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "prompt": "hi", "max_tokens": 8, "temperature": 0.0,
+                    "stop": full_text[1],
+                },
+            )
+            stopped_body = await r.json()
+            stopped = stopped_body["choices"][0]["text"]
+            assert full_text[1] not in stopped
+            assert full_text.startswith(stopped)
+            assert stopped_body["choices"][0]["finish_reason"] == "stop"
+            # budget exhaustion reports "length"
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hi", "max_tokens": 2, "temperature": 0.0},
+            )
+            assert (await r.json())["choices"][0]["finish_reason"] == "length"
+            # malformed knobs are rejected before any engine work
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hi", "stop": 42},
+            )
+            assert r.status == 400
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hi", "max_tokens": "many"},
+            )
+            assert r.status == 400
             # observability surface
             r = await client.get("/metrics")
             text = await r.text()

@@ -60,6 +60,10 @@ class Request:
     # Set by the engine before the terminal None: "stop" (eos) or "length"
     # (max_tokens / context-window cap).
     finish_reason: str = "stop"
+    # Cooperative cancellation: a consumer (e.g. the HTTP layer on a stop-
+    # sequence match) sets this; the scheduler frees the slot at the next
+    # emit instead of decoding to max_tokens.
+    cancelled: bool = False
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -375,12 +379,14 @@ class Engine:
         hit_eos = token_id == eos
         hit_budget = self.slot_generated[slot] >= req.max_tokens
         hit_window = int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
-        if not hit_eos:
+        if not hit_eos and not req.cancelled:
             req.out.put(token_id)
-        if hit_eos or hit_budget or hit_window:
-            # eos is a natural stop; running out of budget or context is a
-            # truncation ("length") clients may want to continue from.
-            req.finish_reason = "stop" if hit_eos else "length"
+        if hit_eos or hit_budget or hit_window or req.cancelled:
+            # eos/cancel are natural stops; running out of budget or context
+            # is a truncation ("length") clients may want to continue from.
+            req.finish_reason = (
+                "stop" if (hit_eos or req.cancelled) else "length"
+            )
             req.out.put(None)
             self.active[slot] = False
             self.slot_req[slot] = None

@@ -32,15 +32,41 @@ class ServerState:
         self.ready = True
 
 
-async def _collect(req: Request) -> list[int]:
-    """Await all tokens of a request without blocking the event loop."""
+def _find_stop(text: str, stop) -> Optional[int]:
+    """Earliest index of any stop sequence in text, or None. The single
+    matching semantic shared by the cancellation trigger and the final
+    truncation."""
+    cuts = [idx for s in stop or [] if s and (idx := text.find(s)) != -1]
+    return min(cuts) if cuts else None
+
+
+async def _collect(req: Request, tokenizer=None, stop=None) -> list[int]:
+    """Await all tokens of a request without blocking the event loop.
+
+    With `stop` sequences, a bounded tail of the accumulating text is
+    checked per token (O(n), not O(n^2)); on a match the engine request is
+    cancelled so its slot frees immediately instead of decoding to
+    max_tokens."""
     loop = asyncio.get_running_loop()
     out: list[int] = []
+    # A match must end at the newest token; decoding the last
+    # 4*max_stop_len+8 tokens always covers it (>=1 byte per token, <=4
+    # bytes per char).
+    window = 4 * max((len(s) for s in stop), default=0) + 8 if stop else 0
     while True:
         tok = await loop.run_in_executor(None, req.out.get)
         if tok is None:
             return out
         out.append(tok)
+        if stop and tokenizer is not None:
+            tail = tokenizer.decode(out[-window:])
+            if _find_stop(tail, stop) is not None:
+                req.cancelled = True
+                while (
+                    await loop.run_in_executor(None, req.out.get) is not None
+                ):
+                    pass
+                return out
 
 
 def _completion_body(state: ServerState, text: str, n_prompt: int,
@@ -189,27 +215,21 @@ def build_app(state: ServerState) -> web.Application:
 
     async def _generate(request: web.Request, prompt: str, body: dict):
         req = _submit(prompt, body)
-        gen_ids = await _collect(req)
+        stop = body.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
+        gen_ids = await _collect(req, state.tokenizer, stop)
         if state.engine.error is not None:
             raise web.HTTPInternalServerError(text=str(state.engine.error))
         text = state.tokenizer.decode(gen_ids)
         # OpenAI `stop`: truncate at the earliest stop sequence (exclusive),
-        # computed over the ORIGINAL text so the result is order-independent.
-        # Non-streaming only; streamed responses don't hold tokens back.
-        # (Engine-level early stop is a future round — today the slot still
-        # decodes to max_tokens.)
-        stop = body.get("stop")
+        # computed over the full text so the result is order-independent.
+        # _collect already cancelled the engine slot when the match appeared
+        # (non-streaming only; streamed responses don't hold tokens back).
         if stop is not None:
-            if isinstance(stop, str):
-                stop = [stop]
-            cuts = [
-                idx
-                for s in stop
-                if s and (idx := text.find(s)) != -1
-            ]
-            if cuts:
-                text = text[: min(cuts)]
-                return text, len(req.prompt_tokens), len(gen_ids), "stop"
+            cut = _find_stop(text, stop)
+            if cut is not None:
+                return text[:cut], len(req.prompt_tokens), len(gen_ids), "stop"
         # The engine recorded why generation ended (eos vs budget/window).
         return text, len(req.prompt_tokens), len(gen_ids), req.finish_reason
 

@@ -173,6 +173,18 @@ def test_http_completions(engine):
                 json={"prompt": "hi", "max_tokens": "many"},
             )
             assert r.status == 400
+            # engine-level early stop: the slot must not decode to
+            # max_tokens once the stop sequence appeared
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "prompt": "hi", "max_tokens": 40, "temperature": 0.0,
+                    "stop": full_text[1],
+                },
+            )
+            early = await r.json()
+            assert early["usage"]["completion_tokens"] < 40, early["usage"]
+            assert early["choices"][0]["finish_reason"] == "stop"
             # observability surface
             r = await client.get("/metrics")
             text = await r.text()

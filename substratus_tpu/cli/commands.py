@@ -333,6 +333,50 @@ def cmd_notebook(args) -> int:
     return run_notebook(args, _client(args))
 
 
+def cmd_logs(args) -> int:
+    """Logs for the workload a CR owns (reference: the TUI's pods panel,
+    internal/tui — pod list/log streaming). Real clusters shell out to
+    kubectl; the fake cluster prints the workload object's status."""
+    client = _client(args)
+    kind = _norm_kind(args.kind)
+    if args.fake and _FAKE_ENV is not None:
+        _FAKE_ENV.step()  # reconcile so just-applied CRs have workloads
+    obj = client.get_or_none(kind, args.namespace, args.name)
+    if obj is None:
+        raise SystemExit(f"{kind.lower()}/{args.name} not found")
+    suffix = {
+        "Dataset": "-data-loader",
+        "Model": "-modeller",
+        "Notebook": "-notebook",
+        "Server": "-server",
+    }[kind]
+    workload = f"{args.name}{suffix}"
+    if args.fake:
+        for wkind in ("Job", "JobSet", "Deployment", "Pod"):
+            w = client.get_or_none(wkind, args.namespace, workload)
+            if w is not None:
+                print(f"{wkind.lower()}/{workload} (fake cluster; no kubelet logs)")
+                print(json.dumps(w.get("status", {}), indent=2))
+                return 0
+        print(f"no workload found for {kind.lower()}/{args.name}")
+        return 1
+    import shutil
+    import subprocess
+
+    kubectl = shutil.which("kubectl")
+    if kubectl is None:
+        raise SystemExit("kubectl not found on PATH")
+    selector = f"substratus.ai/object={kind.lower()}-{args.name}"
+    cmd = [kubectl, "-n", args.namespace, "logs", "-l", selector,
+           "--tail", str(args.tail)]
+    if args.follow:
+        cmd.append("-f")
+    try:
+        return subprocess.call(cmd)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_version(args) -> int:
     from substratus_tpu import __version__
 
@@ -380,6 +424,14 @@ def register(sub) -> None:
     p.add_argument("--no-open", action="store_true")
     common(p)
     p.set_defaults(func=cmd_notebook)
+
+    p = sub.add_parser("logs", help="logs for a CR's workload")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-f", "--follow", action="store_true")
+    p.add_argument("--tail", type=int, default=100)
+    common(p)
+    p.set_defaults(func=cmd_logs)
 
     p = sub.add_parser("serve", help="serve a model locally")
     p.add_argument("--model")

@@ -1,12 +1,22 @@
 """Headline benchmark: Llama-2-7B decode throughput per chip (int8 weights).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
+step_time_ms / mfu / hbm_bw_util alongside the throughput.
 
 Baseline derivation (the reference publishes no perf numbers — BASELINE.md):
 the north star is >=2000 tok/s aggregate serving Llama-2-70B on a v5e-16
 slice, i.e. 125 tok/s/chip at 70B. Decode is HBM-bandwidth-bound, so the
 7B-equivalent per-chip parity target is 125 * (70/7) = 1250 tok/s/chip.
 vs_baseline = measured / 1250.
+
+Robustness contract (the driver records this file's stdout verbatim):
+  - backend init is probed in a child process with a hard timeout and a
+    bounded retry (the TPU device tunnel can wedge; a hang must not eat
+    the whole capture budget);
+  - the measurement itself runs in a watchdog child process;
+  - on any unrecoverable failure the parent STILL prints one parseable
+    JSON line ({"value": null, "error": ...}) and exits 0 — a capture is
+    never an opaque traceback.
 
 Runs on the real chip (no JAX_PLATFORMS override). Weights are random but
 shape/dtype-exact (int8 + per-channel scales created directly on device), so
@@ -20,13 +30,12 @@ cache path.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from substratus_tpu.models import llama
-from substratus_tpu.ops.quant import QTensor
+METRIC_UNIT = "tokens/sec/chip"
 
 # Per-config parity targets (decode is bandwidth-bound, so the 70B-derived
 # 125 tok/s/chip north star scales ~inversely with model size). Configs
@@ -38,10 +47,38 @@ BASELINES = {
     "debug-1b": 8000.0,
 }
 
+# Peak numbers for the MFU / bandwidth-utilization denominators. The target
+# part is TPU v5e (the BASELINE.md north-star hardware): 197 TFLOP/s bf16,
+# 819 GB/s HBM. Reported per-device-kind so a different chip still gets a
+# sane denominator.
+PEAKS = {
+    # device-kind substring -> (peak bf16 flops/s, hbm bytes/s)
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6": (918e12, 1640e9),
+}
+DEFAULT_PEAK = (197e12, 819e9)
 
-def random_quantized_params(cfg: llama.LlamaConfig, key: jax.Array):
+
+def peak_for(device_kind: str):
+    dk = device_kind.lower()
+    for key, peak in PEAKS.items():
+        if key in dk:
+            return peak
+    return DEFAULT_PEAK
+
+
+def random_quantized_params(cfg, key):
     """Random int8 params created quantized (no bf16 transient: a 7B bf16
     tree would not coexist with its int8 copy in 16G HBM)."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.ops.quant import QTensor
+
     contracting = llama.quant_contracting(cfg)
     shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k), key)
 
@@ -65,13 +102,68 @@ def random_quantized_params(cfg: llama.LlamaConfig, key: jax.Array):
     return jax.tree.unflatten(treedef, out)
 
 
-def main(
+def perf_model(cfg, batch: int, mean_pos: float, kv_itemsize: int):
+    """Decode-step roofline accounting from the real parameter tree.
+
+    Returns (flops_per_token, bytes_per_step):
+      flops_per_token — 2*N over matmul (contracting) weights, with routed
+        MoE experts scaled by the active fraction, plus 4*L*H*Dh*pos
+        attention score/value flops;
+      bytes_per_step  — every weight byte read once (batch amortizes) plus
+        the per-sequence KV history read.
+    """
+    import jax
+    import numpy as np
+
+    from substratus_tpu.models import llama
+
+    contracting = llama.quant_contracting(cfg)
+    shapes = jax.eval_shape(
+        lambda k: llama.init_params(cfg, jax.random.key(0)), 0
+    )
+    leaves, treedef = jax.tree.flatten(shapes)
+    contr_leaves = treedef.flatten_up_to(contracting)
+
+    active_frac = 1.0
+    if cfg.n_experts > 0:
+        active_frac = cfg.n_experts_per_token / cfg.n_experts
+
+    matmul_flops = 0.0
+    weight_bytes = 0.0
+    for leaf, contr in zip(leaves, contr_leaves):
+        n = float(np.prod(leaf.shape))
+        if contr:
+            # Expert weights are rank-3 (expert, in, out): only the routed
+            # fraction does useful flops per token; all bytes are still read
+            # each step under expert-parallel decode.
+            frac = active_frac if len(leaf.shape) == 3 else 1.0
+            matmul_flops += 2.0 * n * frac
+            weight_bytes += n * 1 + n / 128.0 * 4  # int8 q + ~per-ch scale
+        else:
+            weight_bytes += n * 2  # bf16 norms/embedding
+
+    attn_flops = 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_size * mean_pos
+    kv_bytes = (
+        2.0 * cfg.n_layers * cfg.n_kv_heads * cfg.head_size
+        * mean_pos * batch * kv_itemsize
+    )
+    return matmul_flops + attn_flops, weight_bytes + kv_bytes
+
+
+def run_measurement(
     batch: int = 16,
     cache_len: int = 512,
     steps: int = 64,
     config: str = "llama2-7b",
     kv_dtype: str = "int8",
 ) -> None:
+    """The measured bench body. Runs in the watchdog child; prints the JSON
+    line on success, raises on failure."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+
     cfg = llama.CONFIGS[config]
     params = jax.jit(
         lambda k: random_quantized_params(cfg, k)
@@ -99,49 +191,142 @@ def main(
     dt = time.perf_counter() - t0
 
     tok_s = batch * steps / dt
+    step_ms = dt / steps * 1e3
+    device = jax.devices()[0]
+    peak_flops, peak_bw = peak_for(getattr(device, "device_kind", ""))
+    kv_itemsize = 1 if kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize
+    mean_pos = pos0 + 1 + steps / 2.0
+    flops_per_tok, bytes_per_step = perf_model(
+        cfg, batch, mean_pos, kv_itemsize
+    )
     baseline = BASELINES.get(config)
     print(
         json.dumps(
             {
                 "metric": f"{config.replace('-', '_')}_int8_decode_throughput_per_chip",
                 "value": round(tok_s, 1),
-                "unit": "tokens/sec/chip",
+                "unit": METRIC_UNIT,
                 "vs_baseline": round(tok_s / baseline, 3) if baseline else None,
+                "step_time_ms": round(step_ms, 3),
+                "mfu": round(flops_per_tok * tok_s / peak_flops, 4),
+                "hbm_bw_util": round(
+                    bytes_per_step / (dt / steps) / peak_bw, 3
+                ),
+                "batch": batch,
+                "cache_len": cache_len,
+                "device": getattr(device, "device_kind", str(device)),
             }
         )
     )
 
 
-if __name__ == "__main__":
+def emit_failure(config: str, error: str) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": f"{config.replace('-', '_')}_int8_decode_throughput_per_chip",
+                "value": None,
+                "unit": METRIC_UNIT,
+                "vs_baseline": None,
+                "error": error[-800:],
+            }
+        )
+    )
+
+
+def looks_oom(text: str) -> bool:
+    return any(
+        marker in text
+        for marker in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+                       "exceeds the memory")
+    )
+
+
+def probe_backend(timeout_s: float = 90.0, attempts: int = 3) -> str | None:
+    """Confirm a usable jax backend exists, in a child with a hard timeout
+    (a wedged device tunnel HANGS rather than fails). Returns an error
+    string, or None when healthy."""
+    code = (
+        "import jax; d = jax.devices(); "
+        "print(d[0].platform, len(d), getattr(d[0], 'device_kind', ''))"
+    )
+    last = "unknown"
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"backend init hang (> {timeout_s:.0f}s; wedged tunnel?)"
+        else:
+            if proc.returncode == 0:
+                print(f"backend ok: {proc.stdout.strip()}", file=sys.stderr)
+                return None
+            last = (proc.stderr.strip() or proc.stdout.strip())[-400:]
+        print(
+            f"backend probe attempt {i + 1}/{attempts} failed: {last}",
+            file=sys.stderr,
+        )
+        if i + 1 < attempts:
+            time.sleep(10.0)
+    return last
+
+
+def child_argv(batch, cache_len, steps, config, kv_dtype):
+    return [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--batch", str(batch), "--cache-len", str(cache_len),
+        "--steps", str(steps), "--config", config, "--kv-dtype", kv_dtype,
+    ]
+
+
+def main() -> int:
     import argparse
-    import sys
-    import traceback
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--cache-len", type=int, default=512)
     ap.add_argument("--steps", type=int, default=64)
-    ap.add_argument(
-        "--config", default="llama2-7b", choices=sorted(llama.CONFIGS)
-    )
+    ap.add_argument("--config", default="llama2-7b")  # validated below
     ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
     ap.add_argument(
         "--no-fallback", action="store_true",
         help="fail instead of retrying smaller tiers",
     )
+    ap.add_argument(
+        "--child", action="store_true",
+        help="internal: run the measurement in-process (watchdog target)",
+    )
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument(
+        "--run-timeout", type=float, default=1500.0,
+        help="hard wall-clock limit per measurement attempt",
+    )
     a = ap.parse_args()
 
-    def is_oom(e: BaseException) -> bool:
-        text = f"{type(e).__name__}: {e}"
-        return any(
-            marker in text
-            for marker in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
-                           "exceeds the memory")
+    if a.child:
+        run_measurement(a.batch, a.cache_len, a.steps, a.config, a.kv_dtype)
+        return 0
+
+    # Validate --config up front (importing the module does not initialize
+    # any jax backend, so this is hang-safe even under a wedged tunnel): a
+    # typo must be an argparse-style error, not a null "failed capture".
+    from substratus_tpu.models import llama
+
+    if a.config not in llama.CONFIGS:
+        ap.error(
+            f"--config {a.config!r} not in {sorted(llama.CONFIGS)}"
         )
+
+    err = probe_backend(a.probe_timeout)
+    if err is not None:
+        emit_failure(a.config, f"backend unavailable: {err}")
+        return 0
 
     # Fallback ladder: an out-of-memory on the headline config retries
     # smaller batches, then a smaller model, so a hardware run always lands
-    # a number. Non-OOM errors fail fast.
+    # a number. Non-OOM errors terminate the ladder (and still emit JSON).
     tiers = [
         (a.batch, a.cache_len, a.config),
         (max(1, a.batch // 2), a.cache_len, a.config),
@@ -152,16 +337,36 @@ if __name__ == "__main__":
         tiers = tiers[:1]
     seen = set()
     tiers = [t for t in tiers if not (t in seen or seen.add(t))]
+    last_err = "no tiers ran"
     for i, (batch, cache_len, config) in enumerate(tiers):
+        argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype)
         try:
-            main(batch, cache_len, a.steps, config, a.kv_dtype)
-            break
-        except Exception as e:
-            traceback.print_exc(file=sys.stderr)
-            if i == len(tiers) - 1 or not is_oom(e):
-                raise
-            print(
-                f"bench tier (batch={batch}, cache={cache_len}, "
-                f"config={config}) hit OOM; retrying smaller",
-                file=sys.stderr,
+            proc = subprocess.run(
+                argv, capture_output=True, text=True, timeout=a.run_timeout,
             )
+        except subprocess.TimeoutExpired:
+            last_err = f"measurement hang (> {a.run_timeout:.0f}s)"
+            break  # a hang will not get better at a smaller tier
+        sys.stderr.write(proc.stderr)
+        if proc.returncode == 0 and proc.stdout.strip():
+            # Relay the child's JSON line (last stdout line) verbatim.
+            print(proc.stdout.strip().splitlines()[-1])
+            return 0
+        # Classify on the FULL stderr (XLA's OOM dumps append a multi-KB
+        # allocation table after the RESOURCE_EXHAUSTED marker); truncate
+        # only what gets embedded in the JSON.
+        full_err = proc.stderr.strip() or f"rc={proc.returncode}"
+        last_err = full_err[-800:]
+        if not looks_oom(full_err):
+            break
+        print(
+            f"bench tier (batch={batch}, cache={cache_len}, "
+            f"config={config}) hit OOM; retrying smaller",
+            file=sys.stderr,
+        )
+    emit_failure(a.config, last_err)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -242,6 +242,31 @@ def cache_logical_axes(cfg: LlamaConfig, quantized: bool = False) -> Params:
     return axes
 
 
+# The engine may use a paged (block) KV layout for this family (serve/
+# paged_kv.py owns the allocator; ops/kvcache.py owns the device ops).
+SUPPORTS_PAGED = True
+
+
+def init_paged_cache(
+    cfg: LlamaConfig, pages: int, page_size: int, dtype=None
+) -> Params:
+    """Paged decode cache: a global page pool k/v [L, P, bs, KH, head_dim]
+    addressed through a per-sequence block table (ops/kvcache.py)."""
+    from substratus_tpu.ops import kvcache
+
+    dtype = dtype or cfg.dtype
+    return kvcache.init_paged_cache(
+        cfg.n_layers, pages, page_size, cfg.n_kv_heads, cfg.head_size,
+        dtype, quantized=dtype == jnp.int8,
+    )
+
+
+def paged_cache_logical_axes(cfg: LlamaConfig, quantized: bool = False) -> Params:
+    from substratus_tpu.ops import kvcache
+
+    return kvcache.paged_cache_logical_axes(quantized)
+
+
 def _self_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -371,6 +396,7 @@ def _block(
     lora_layers: Optional[Params] = None,  # single-layer adapter tree
     lora_scale: float = 1.0,
     train: bool = False,
+    block_table: Optional[jnp.ndarray] = None,  # [B, M]: paged cache layout
 ) -> Tuple[jnp.ndarray, Params, jnp.ndarray]:
     """One transformer block. Returns (x_out, kv_out, aux): kv_out is a dict
     of either the freshly computed seq entries {k, v} (no cache: training /
@@ -396,6 +422,16 @@ def _block(
     if layer_cache is None:
         attn = _self_attention(q, kk, vv, positions, cfg)
         kv_out = {"k": kk, "v": vv}
+    elif block_table is not None:
+        from substratus_tpu.ops.kvcache import paged_update_and_read
+
+        kv_out, k_cache, v_cache = paged_update_and_read(
+            layer_cache, block_table, positions, kk, vv, dt
+        )
+        attn = dot_product_attention(
+            q, k_cache, v_cache, causal=True, q_positions=positions,
+            kv_length=kv_length,
+        )
     else:
         from substratus_tpu.ops.quant import dequantize_kv, quantize_kv
 
@@ -455,7 +491,10 @@ def forward(
     cfg: LlamaConfig,
     *,
     positions: Optional[jnp.ndarray] = None,  # [B, S] absolute positions
-    cache: Optional[Params] = None,  # decode cache from init_cache
+    cache: Optional[Params] = None,  # decode cache from init_cache (dense)
+    # or init_paged_cache (pass block_table too)
+    block_table: Optional[jnp.ndarray] = None,  # [B, M] page ids: selects
+    # the paged cache layout (ops/kvcache.py)
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix; use
     # when slots <= position may hold stale data (e.g. resumed caches)
     lora: Optional[Params] = None,  # adapter tree from train.lora.init_lora
@@ -489,6 +528,7 @@ def forward(
             layer_in.get("lora"),
             lora_scale,
             train,
+            block_table,
         )
         return x_out, {"kv": kv, "aux": aux}
 

@@ -1,0 +1,120 @@
+"""Paged KV-cache device ops (vLLM/JetStream-style block layout, XLA path).
+
+The reference served models through external images with per-request
+contiguous caches (SURVEY.md §2.2); the TPU-native engine instead keeps one
+global page pool per layer
+
+    k/v        [pages, page_size, kv_heads, head_dim]
+    (+ scales  [pages, page_size, kv_heads, 1] when int8-quantized)
+
+and a per-sequence block table [B, max_pages] of page ids. Shapes stay fully
+static under jit (TPU requirement): dynamism lives in the *contents* of the
+block table. Memory is bounded by actual tokens in flight, not
+batch x max_seq_len, and identical prompt prefixes can share pages
+(serve/paged_kv.py owns the host-side allocator / prefix registry).
+
+This XLA implementation scatters new entries via flat token indices and
+gathers each sequence's context as a slot-local [B, max_pages*page_size]
+view, so the framework's standard masked attention applies unchanged:
+gathered index j IS the token's absolute position in its sequence, hence
+causal masking (k_pos <= q_pos) hides unwritten / foreign pages. A Pallas
+decode kernel can later read pages in place through the same block table.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from substratus_tpu.ops.quant import dequantize_kv, quantize_kv
+
+
+def paged_update_and_read(
+    layer_cache: Dict[str, jnp.ndarray],
+    block_table: jnp.ndarray,  # [B, M] int32 page ids
+    positions: jnp.ndarray,  # [B, S] absolute (slot-local) positions
+    k_new: jnp.ndarray,  # [B, S, KH, hd]
+    v_new: jnp.ndarray,
+    dtype,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Write new entries at `positions`, then gather the full slot-local
+    context. Returns (updated layer_cache, k_ctx, v_ctx [B, M*bs, KH, hd]).
+
+    Duplicate positions (bucket-padding clamps) write in unspecified order —
+    only ever at the one-past-the-prompt garbage slot, which the first
+    decode step overwrites before attending (engine contract).
+    """
+    pages, bs = layer_cache["k"].shape[:2]
+    b, m = block_table.shape
+
+    def flat(a):
+        return a.reshape((pages * bs,) + a.shape[2:])
+
+    pid = jnp.take_along_axis(block_table, positions // bs, axis=1)
+    idx = pid * bs + positions % bs  # [B, S] flat token index
+    ctx_idx = (
+        block_table[:, :, None] * bs
+        + jnp.arange(bs, dtype=block_table.dtype)[None, None, :]
+    ).reshape(b, m * bs)
+
+    quantized = "k_scale" in layer_cache
+    out: Dict[str, jnp.ndarray] = {}
+    if quantized:
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        for name, vals in (
+            ("k", kq), ("v", vq), ("k_scale", ks), ("v_scale", vs)
+        ):
+            out[name] = (
+                flat(layer_cache[name]).at[idx].set(vals)
+                .reshape(layer_cache[name].shape)
+            )
+        k_ctx = dequantize_kv(
+            flat(out["k"])[ctx_idx], flat(out["k_scale"])[ctx_idx], dtype
+        )
+        v_ctx = dequantize_kv(
+            flat(out["v"])[ctx_idx], flat(out["v_scale"])[ctx_idx], dtype
+        )
+    else:
+        for name, vals in (("k", k_new), ("v", v_new)):
+            cdtype = layer_cache[name].dtype
+            out[name] = (
+                flat(layer_cache[name]).at[idx].set(vals.astype(cdtype))
+                .reshape(layer_cache[name].shape)
+            )
+        k_ctx = flat(out["k"])[ctx_idx]
+        v_ctx = flat(out["v"])[ctx_idx]
+    return out, k_ctx, v_ctx
+
+
+def init_paged_cache(
+    n_layers: int,
+    pages: int,
+    page_size: int,
+    kv_heads: int,
+    head_dim: int,
+    dtype,
+    quantized: bool = False,
+) -> Dict[str, jnp.ndarray]:
+    """Layers-stacked page pool: k/v [L, P, bs, KH, hd] (+ f32 scales)."""
+    shape = (n_layers, pages, page_size, kv_heads, head_dim)
+    cache = {
+        "k": jnp.zeros(shape, jnp.int8 if quantized else dtype),
+        "v": jnp.zeros(shape, jnp.int8 if quantized else dtype),
+    }
+    if quantized:
+        sshape = shape[:-1] + (1,)
+        cache["k_scale"] = jnp.ones(sshape, jnp.float32)
+        cache["v_scale"] = jnp.ones(sshape, jnp.float32)
+    return cache
+
+
+def paged_cache_logical_axes(quantized: bool = False) -> Dict[str, tuple]:
+    """Pool axes: pages/page_size replicated (block tables are global; only
+    kv_heads shards, over "tensor" — decode collectives then ride ICI)."""
+    ax = ("layers", None, None, "kv_heads", "head_dim")
+    axes = {"k": ax, "v": ax}
+    if quantized:
+        axes["k_scale"] = ax
+        axes["v_scale"] = ax
+    return axes

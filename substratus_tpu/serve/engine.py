@@ -45,6 +45,18 @@ class EngineConfig:
     # "model" keeps the cache in the model dtype; "int8" stores entries
     # quantized per-vector (llama family) — decode cache reads halve.
     kv_cache_dtype: str = "model"
+    # KV memory layout: "paged" (block pool + per-slot block tables,
+    # ops/kvcache.py — memory bounded by actual tokens, prefix sharing,
+    # preempt-and-resume under pressure), "dense" (one max_seq_len region
+    # per slot), or "auto" (paged when the model family supports it).
+    kv_layout: str = "auto"
+    page_size: int = 16  # tokens per KV page (paged layout)
+    # Total pool size in tokens (paged). None = max_batch * max_seq_len
+    # (the dense footprint); set lower to oversubscribe slots against real
+    # usage — the scheduler preempts (and later resumes) the youngest slot
+    # if the pool runs dry mid-decode.
+    kv_pool_tokens: Optional[int] = None
+    prefix_cache: bool = True  # share full prompt-prefix pages across requests
 
 
 @dataclass
@@ -131,6 +143,19 @@ class Engine:
             )
         cache_dtype = jnp.int8 if kv_int8 else None
 
+        layout = ec.kv_layout
+        if layout == "auto":
+            layout = (
+                "paged" if getattr(model, "SUPPORTS_PAGED", False) else "dense"
+            )
+        if layout not in ("paged", "dense"):
+            raise ValueError(f"kv_layout {layout!r} invalid")
+        if layout == "paged" and not getattr(model, "SUPPORTS_PAGED", False):
+            raise ValueError(
+                f"kv_layout=paged unsupported for {model.__name__}"
+            )
+        self.paged = layout == "paged"
+
         self.mesh = mesh
         if mesh is not None:
             from substratus_tpu.parallel.sharding import SERVE_RULES, shard_tree
@@ -138,6 +163,50 @@ class Engine:
             self.params = shard_tree(
                 params, mesh, model.param_logical_axes(cfg), SERVE_RULES
             )
+
+        if self.paged:
+            from substratus_tpu.serve.paged_kv import (
+                PageAllocator,
+                PrefixRegistry,
+                SlotPages,
+            )
+
+            bs = ec.page_size
+            if bs < 1:
+                raise ValueError(f"page_size {bs} invalid")
+            if ec.kv_pool_tokens is not None and ec.kv_pool_tokens < 1:
+                raise ValueError(
+                    f"kv_pool_tokens {ec.kv_pool_tokens} invalid"
+                )
+            # A single full-length sequence (+ its pad slot) must always fit.
+            pool_tokens = (
+                B * S if ec.kv_pool_tokens is None else ec.kv_pool_tokens
+            )
+            pool_tokens = max(pool_tokens, S + bs)
+            self.page_size = bs
+            self.n_pages = -(-pool_tokens // bs)
+            self.max_pages = -(-S // bs)  # block-table width per slot
+            # Physical page 0 is the trash page: idle slots' decode writes
+            # land there (their block-table rows are zero), never in a live
+            # page. The allocator hands out ids 1..n_pages.
+            pool = model.init_paged_cache(
+                cfg, self.n_pages + 1, bs, dtype=cache_dtype
+            )
+            if mesh is not None:
+                pool = shard_tree(
+                    pool,
+                    mesh,
+                    model.paged_cache_logical_axes(cfg, quantized=kv_int8),
+                    SERVE_RULES,
+                )
+            self.cache = pool
+            self.block_table = jnp.zeros((B, self.max_pages), jnp.int32)
+            self.alloc = PageAllocator(self.n_pages, first_page=1)
+            self.prefix = (
+                PrefixRegistry(self.alloc) if ec.prefix_cache else None
+            )
+            self.slot_pages = SlotPages(B)
+        elif mesh is not None:
             self.cache = shard_tree(
                 model.init_cache(cfg, B, S, dtype=cache_dtype),
                 mesh,
@@ -159,6 +228,22 @@ class Engine:
         self.slot_generated: List[int] = [0] * B
         self.active = np.zeros(B, dtype=bool)
         self.host_positions = np.zeros(B, dtype=np.int64)
+        # Emitted tokens per slot (paged preempt-and-resume rebuilds the
+        # prompt from these) and admission order (preemption picks the
+        # youngest victim, vLLM-style LIFO).
+        self.slot_tokens: List[List[int]] = [[] for _ in range(B)]
+        self.slot_admit_seq: List[int] = [0] * B
+        self._admit_counter = 0
+        # Requests to re-admit before the queue: preempted slots (front)
+        # and admission backpressure (pool dry at prefill time).
+        self._resume: List[Request] = []
+        self.stats: Dict[str, int] = {
+            "prefill_tokens": 0,
+            "prefix_hit_tokens": 0,
+            "preemptions": 0,
+            "truncated_by_pool": 0,
+            "max_active": 0,
+        }
 
         self.queue: "queue.Queue[Request]" = queue.Queue()
         self._stop = threading.Event()
@@ -167,10 +252,11 @@ class Engine:
         self._admitting: Optional[Request] = None
 
         self._decode_fn = self._build_decode()
-        self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
         self._chunk_fn = partial(self._chunk_prefill_jit, self.model, self.cfg)
-        self._insert_fn = self._build_insert()
-        self._extract_slot, self._restore_slot = self._build_slot_io()
+        if not self.paged:
+            self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
+            self._insert_fn = self._build_insert()
+            self._extract_slot, self._restore_slot = self._build_slot_io()
 
     # --- jitted device functions -----------------------------------------
 
@@ -188,19 +274,22 @@ class Engine:
     @staticmethod
     @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
     def _chunk_prefill_jit(model, cfg, params, slot_cache, tokens, offset,
-                           true_len):
+                           true_len, block_table=None):
         """One chunk of a long prefill: tokens [1, C] (right-padded) written
-        into the single-slot cache at absolute positions offset..offset+C-1.
-        Returns (logits of the last real token, updated slot cache)."""
+        at absolute positions offset..offset+C-1 — into a single-slot dense
+        cache, or through a block-table row [1, M] into the paged pool.
+        Returns (logits of the last real token, updated cache)."""
         c = tokens.shape[1]
         positions = offset + jnp.arange(c, dtype=jnp.int32)[None, :]
         # Padded tail positions all clamp onto the single slot one past the
         # prompt: real queries never attend it (causal mask), and the first
         # decode step writes that exact slot before reading it. The caller
-        # keeps prompts <= max_seq_len - 1 so the slot exists.
+        # keeps prompts <= max_seq_len - 1 so the slot exists (paged: and
+        # allocates pages through that slot).
         positions = jnp.minimum(positions, offset + true_len)
+        kw = {} if block_table is None else {"block_table": block_table}
         logits, slot_cache = model.forward(
-            params, tokens, cfg, positions=positions, cache=slot_cache
+            params, tokens, cfg, positions=positions, cache=slot_cache, **kw
         )
         return logits[0, true_len - 1], slot_cache
 
@@ -251,16 +340,18 @@ class Engine:
         return insert
 
     def _build_decode(self):
-        cfg, ec, model = self.cfg, self.ec, self.model
+        cfg, ec, model, paged = self.cfg, self.ec, self.model, self.paged
 
         @partial(jax.jit, donate_argnums=(1,))
-        def decode(params, cache, tokens, positions, temps, top_ps, key):
+        def decode(params, cache, block_table, tokens, positions, temps,
+                   top_ps, key):
             logits, cache = model.forward(
                 params,
                 tokens[:, None],
                 cfg,
                 positions=positions[:, None],
                 cache=cache,
+                **({"block_table": block_table} if paged else {}),
             )
             key, subkey = jax.random.split(key)
             next_tokens = sample(
@@ -288,6 +379,18 @@ class Engine:
         if self._thread:
             self._thread.join(timeout=30)
 
+    def _next_request(self) -> Optional[Request]:
+        """Resumed/held-back requests board before the public queue."""
+        if self._resume:
+            return self._resume.pop(0)
+        try:
+            return self.queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _has_pending(self) -> bool:
+        return bool(self._resume) or not self.queue.empty()
+
     def _admit(self):
         """Fill free slots from the request queue (prefill + insert).
 
@@ -305,53 +408,222 @@ class Engine:
         )
         while (
             admitted < cap
-            and not self.queue.empty()
+            and self._has_pending()
             and not self.active.all()
         ):
-            admitted += 1
-            try:
-                req = self.queue.get_nowait()
-            except queue.Empty:
-                return
+            req = self._next_request()
+            if req is None:
+                break
             self._admitting = req
             slot = int(np.flatnonzero(~self.active)[0])
-            # Keep the newest tokens that fit the cache (minus one slot for
-            # generation); prompts longer than one prefill bucket run as a
-            # sequence of chunked prefills against the slot's cache.
-            keep = self.ec.max_seq_len - 1
-            prompt = req.prompt_tokens[-keep:]
-            true_len = len(prompt)
-            if true_len <= self.ec.max_prefill_len:
-                padded, true_len = _pad_to_bucket(
-                    prompt, self.ec.max_prefill_len
-                )
-                last_logits, kv = self._prefill_fn(
-                    self.params, padded, true_len
-                )
-                self.cache = self._insert_fn(self.cache, kv, slot)
+            if self.paged:
+                ok = self._admit_paged(req, slot)
             else:
-                last_logits = self._chunked_prefill(prompt, slot)
-            # Sample the first generated token from the prefill logits.
-            self.key, subkey = jax.random.split(self.key)
-            first = sample(
-                last_logits[None, :],
-                subkey,
-                jnp.array([req.temperature], jnp.float32),
-                top_k=self.ec.top_k,
-                top_p=jnp.array([req.top_p], jnp.float32),
-            )
-            first_id = int(first[0])
-
-            self.slot_req[slot] = req
-            self.slot_generated[slot] = 0
-            self.active[slot] = True
-            self.host_positions[slot] = true_len
-            self.tokens = self.tokens.at[slot].set(first_id)
-            self.positions = self.positions.at[slot].set(true_len)
-            self.temps = self.temps.at[slot].set(req.temperature)
-            self.top_ps = self.top_ps.at[slot].set(req.top_p)
+                ok = self._admit_dense(req, slot)
             self._admitting = None
-            self._emit(slot, first_id)
+            if not ok:
+                # Pool dry even after eviction: hold the request at the
+                # front of the line; decoding slots will free pages.
+                self._resume.insert(0, req)
+                break
+            admitted += 1
+        self.stats["max_active"] = max(
+            self.stats["max_active"], int(self.active.sum())
+        )
+
+    def _admit_dense(self, req: Request, slot: int) -> bool:
+        # Keep the newest tokens that fit the cache (minus one slot for
+        # generation); prompts longer than one prefill bucket run as a
+        # sequence of chunked prefills against the slot's cache.
+        keep = self.ec.max_seq_len - 1
+        prompt = req.prompt_tokens[-keep:]
+        true_len = len(prompt)
+        if true_len <= self.ec.max_prefill_len:
+            padded, true_len = _pad_to_bucket(
+                prompt, self.ec.max_prefill_len
+            )
+            last_logits, kv = self._prefill_fn(
+                self.params, padded, true_len
+            )
+            self.cache = self._insert_fn(self.cache, kv, slot)
+        else:
+            last_logits = self._chunked_prefill(prompt, slot)
+        self.stats["prefill_tokens"] += true_len
+        self._finalize_admit(req, slot, last_logits, true_len)
+        return True
+
+    def _admit_paged(self, req: Request, slot: int) -> bool:
+        """Paged admission: match shared prefix pages, allocate the rest,
+        chunk-prefill only the unshared remainder through the slot's
+        block-table row, then publish this prompt's full pages."""
+        from substratus_tpu.serve.paged_kv import chain_entries
+
+        bs = self.page_size
+        keep = self.ec.max_seq_len - 1
+        # Degenerate empty prompt: run one pad token through the model so
+        # first-token logits exist (same tolerance as the dense path).
+        prompt = req.prompt_tokens[-keep:] or [0]
+        true_len = len(prompt)
+
+        entries = (
+            chain_entries(prompt, bs) if self.prefix is not None else []
+        )
+        # Reuse at most the pages strictly before the last prompt token:
+        # the last token must run through the model for its logits.
+        max_shared = (true_len - 1) // bs
+        shared = (
+            self.prefix.match(entries[:max_shared])
+            if self.prefix is not None
+            else []
+        )
+        reuse = len(shared) * bs
+        # Claim the shared pages BEFORE allocating owned ones: _try_alloc
+        # may evict registry entries under pressure, and an unclaimed
+        # matched page could be evicted-then-reallocated into `owned`,
+        # aliasing one physical page as both prefix and tail.
+        if shared:
+            self.prefix.claim(shared)
+        # Own pages covering slot-local tokens reuse..true_len (inclusive:
+        # bucket-padding clamps one write onto the one-past-prompt slot).
+        need = -(-(true_len + 1) // bs) - len(shared)
+        owned = self._try_alloc(need)
+        if owned is None:
+            for pid in shared:
+                self.alloc.decref(pid)
+            return False
+        self.slot_pages.assign(slot, shared, owned)
+        pages = self.slot_pages.pages[slot]
+        row = np.zeros((self.max_pages,), np.int32)
+        row[: len(pages)] = pages
+        self.block_table = self.block_table.at[slot].set(jnp.asarray(row))
+        bt_row = self.block_table[slot : slot + 1]
+
+        chunk = self.ec.max_prefill_len
+        offset = reuse
+        last_logits = None
+        while offset < true_len:
+            padded, clen = _pad_to_bucket(
+                prompt[offset : offset + chunk], chunk
+            )
+            last_logits, self.cache = self._chunk_fn(
+                self.params, self.cache, padded, offset, clen,
+                block_table=bt_row,
+            )
+            offset += clen
+        self.stats["prefill_tokens"] += true_len - reuse
+        self.stats["prefix_hit_tokens"] += reuse
+
+        n_full = true_len // bs
+        if self.prefix is not None and n_full:
+            self.prefix.register(entries[:n_full], pages[:n_full])
+        self._finalize_admit(req, slot, last_logits, true_len)
+        return True
+
+    def _finalize_admit(self, req: Request, slot: int, last_logits,
+                        true_len: int) -> None:
+        # Sample the first generated token from the prefill logits.
+        self.key, subkey = jax.random.split(self.key)
+        first = sample(
+            last_logits[None, :],
+            subkey,
+            jnp.array([req.temperature], jnp.float32),
+            top_k=self.ec.top_k,
+            top_p=jnp.array([req.top_p], jnp.float32),
+        )
+        first_id = int(first[0])
+
+        self.slot_req[slot] = req
+        self.slot_generated[slot] = 0
+        self.active[slot] = True
+        self.host_positions[slot] = true_len
+        self.slot_tokens[slot] = []
+        self._admit_counter += 1
+        self.slot_admit_seq[slot] = self._admit_counter
+        self.tokens = self.tokens.at[slot].set(first_id)
+        self.positions = self.positions.at[slot].set(true_len)
+        self.temps = self.temps.at[slot].set(req.temperature)
+        self.top_ps = self.top_ps.at[slot].set(req.top_p)
+        self._emit(slot, first_id)
+
+    # --- paged pool management -------------------------------------------
+
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages, evicting LRU prefix-registry entries under
+        pressure; None (nothing leaked) when the pool is truly dry."""
+        got: List[int] = []
+        while len(got) < n:
+            pid = self.alloc.alloc()
+            if pid is not None:
+                got.append(pid)
+                continue
+            if self.prefix is not None and self.prefix.evict_lru():
+                continue
+            for p in got:
+                self.alloc.decref(p)
+            return None
+        return got
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """Youngest active slot (LIFO preemption preserves the oldest
+        requests' progress)."""
+        best, best_seq = None, -1
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            if slot == exclude:
+                continue
+            if self.slot_admit_seq[slot] > best_seq:
+                best, best_seq = slot, self.slot_admit_seq[slot]
+        return best
+
+    def _preempt(self, victim: int) -> None:
+        """Evict a slot mid-decode: its pages free now; the request (same
+        object — cancellation flags stay live) re-boards at the front with
+        prompt := prompt + generated-so-far, so re-prefill reconstructs the
+        exact state and generation continues seamlessly."""
+        req = self.slot_req[victim]
+        gen = self.slot_tokens[victim]
+        req.prompt_tokens = list(req.prompt_tokens) + gen
+        req.max_tokens -= len(gen)
+        self._release_slot(victim)
+        self._resume.insert(0, req)
+        self.stats["preemptions"] += 1
+
+    def _ensure_capacity(self, slot: int) -> None:
+        """Before a decode step writes at host_positions[slot], make sure
+        the page backing that position exists — allocating, evicting
+        prefix entries, then preempting the youngest other slot, in that
+        order. Last resort (single survivor, pool exhausted): finish the
+        request as truncated."""
+        if not self.active[slot]:
+            return  # preempted earlier in this same pass
+        pn = int(self.host_positions[slot]) // self.page_size
+        if pn < len(self.slot_pages.pages[slot]):
+            return
+        got = self._try_alloc(1)
+        while got is None:
+            victim = self._pick_victim(exclude=slot)
+            if victim is None:
+                req = self.slot_req[slot]
+                req.finish_reason = "length"
+                req.out.put(None)
+                self._release_slot(slot)
+                self.stats["truncated_by_pool"] += 1
+                return
+            self._preempt(victim)
+            got = self._try_alloc(1)
+        self.slot_pages.append(slot, got[0])
+        self.block_table = self.block_table.at[slot, pn].set(got[0])
+
+    def _release_slot(self, slot: int) -> None:
+        self.active[slot] = False
+        self.slot_req[slot] = None
+        self.slot_tokens[slot] = []
+        if self.paged:
+            self.slot_pages.release(slot, self.alloc)
+            # Point the idle slot back at the trash page; its decode writes
+            # keep happening (static shapes) and must never land in a page
+            # the allocator may hand to someone else.
+            self.block_table = self.block_table.at[slot].set(0)
 
     def _chunked_prefill(self, prompt, slot: int):
         """Prefill a prompt longer than one bucket: run bucket-sized chunks
@@ -381,6 +653,7 @@ class Engine:
         hit_window = int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
         if not hit_eos and not req.cancelled:
             req.out.put(token_id)
+            self.slot_tokens[slot].append(token_id)
         if hit_eos or hit_budget or hit_window or req.cancelled:
             # eos/cancel are natural stops; running out of budget or context
             # is a truncation ("length") clients may want to continue from.
@@ -388,19 +661,24 @@ class Engine:
                 "stop" if (hit_eos or req.cancelled) else "length"
             )
             req.out.put(None)
-            self.active[slot] = False
-            self.slot_req[slot] = None
+            self._release_slot(slot)
 
     def _loop(self):
         try:
             while not self._stop.is_set():
                 self._admit()
+                if self.paged:
+                    # Grow every slot that will cross a page boundary this
+                    # step (may preempt or, at the limit, truncate).
+                    for slot in np.flatnonzero(self.active):
+                        self._ensure_capacity(int(slot))
                 if not self.active.any():
                     time.sleep(0.002)
                     continue
                 next_tokens, self.cache, self.key = self._decode_fn(
                     self.params,
                     self.cache,
+                    self.block_table if self.paged else None,
                     self.tokens,
                     self.positions,
                     self.temps,
@@ -420,6 +698,8 @@ class Engine:
             for req in self.slot_req:
                 if req is not None:
                     req.out.put(None)
+            for req in self._resume:
+                req.out.put(None)
             while not self.queue.empty():
                 try:
                     self.queue.get_nowait().out.put(None)

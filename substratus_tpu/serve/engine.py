@@ -368,6 +368,13 @@ class Engine:
             req.out.put(None)  # engine is dead; never strand the caller
             return req
         self.queue.put(req)
+        if self.error is not None:
+            # The scheduler may have died between the check above and the
+            # put — its one-time queue drain could have run before the put,
+            # stranding the request. error is always set BEFORE the drain,
+            # so re-checking here guarantees a terminal marker either way
+            # (a duplicate None in a dead request's queue is harmless).
+            req.out.put(None)
         return req
 
     def start(self):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import time
 import uuid
 from typing import Optional
@@ -60,7 +61,14 @@ async def _collect(req: Request, tokenizer=None, stop=None) -> list[int]:
         out.append(tok)
         if stop and tokenizer is not None:
             tail = tokenizer.decode(out[-window:])
-            if _find_stop(tail, stop) is not None:
+            if _find_stop(tail, stop) is not None and _find_stop(
+                tokenizer.decode(out), stop
+            ) is not None:
+                # The tail decode is a cheap filter; BPE boundary effects
+                # (leading-space stripping) can make it differ from the
+                # suffix of the full decode, so confirm on the full text
+                # before cancelling — a false positive would silently
+                # truncate output while reporting finish_reason "stop".
                 req.cancelled = True
                 while (
                     await loop.run_in_executor(None, req.out.get) is not None
@@ -188,18 +196,29 @@ def build_app(state: ServerState) -> web.Application:
             raise web.HTTPBadRequest(
                 text="'stop' must be a string or list of strings"
             )
-        for key in ("max_tokens",):
-            if key in body:
-                try:
-                    int(body[key])
-                except (TypeError, ValueError):
-                    raise web.HTTPBadRequest(text=f"'{key}' must be an integer")
+        if "max_tokens" in body:
+            try:
+                v = int(body["max_tokens"])
+            except (TypeError, ValueError):
+                raise web.HTTPBadRequest(text="'max_tokens' must be an integer")
+            if v < 1:
+                raise web.HTTPBadRequest(text="'max_tokens' must be >= 1")
         for key in ("temperature", "top_p"):
             if key in body:
                 try:
-                    float(body[key])
+                    v = float(body[key])
                 except (TypeError, ValueError):
                     raise web.HTTPBadRequest(text=f"'{key}' must be a number")
+                if not math.isfinite(v):
+                    # json.loads accepts NaN/Infinity literals, and NaN
+                    # passes any < comparison — reject explicitly.
+                    raise web.HTTPBadRequest(text=f"'{key}' must be finite")
+                if key == "temperature" and v < 0:
+                    raise web.HTTPBadRequest(text="'temperature' must be >= 0")
+                if key == "top_p" and not (0 < v <= 1):
+                    raise web.HTTPBadRequest(
+                        text="'top_p' must be in (0, 1]"
+                    )
 
     def _submit(prompt: str, body: dict) -> Request:
         tok = state.tokenizer
@@ -240,6 +259,11 @@ def build_app(state: ServerState) -> web.Application:
         then [DONE]. The engine already streams per-token through the
         request queue; this just relays it."""
         req = _submit(prompt, body)
+        if state.engine.error is not None:
+            raise web.HTTPInternalServerError(text=str(state.engine.error))
+        stop = body.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -250,29 +274,14 @@ def build_app(state: ServerState) -> web.Application:
         loop = asyncio.get_running_loop()
         created = int(time.time())
         cid = f"cmpl-{uuid.uuid4().hex[:24]}"
-        pending: list[int] = []
-        while True:
-            tok_id = await loop.run_in_executor(None, req.out.get)
-            if tok_id is None:
-                if pending:  # flush any held-back trailing bytes
-                    piece = state.tokenizer.decode(pending)
-                    yield_final = True
-                else:
-                    break
-            else:
-                pending.append(tok_id)
-                piece = state.tokenizer.decode(pending)
-                # Hold back a partial UTF-8 codepoint, but never more than 4
-                # tokens (genuinely invalid bytes must still stream).
-                if "�" in piece and len(pending) < 4:
-                    continue
-                yield_final = False
-            pending = []
+
+        async def write_piece(piece: str, finish=None):
             if chat:
-                choice = {"index": 0, "delta": {"content": piece}, "finish_reason": None}
+                delta = {"content": piece} if piece else {}
+                choice = {"index": 0, "delta": delta, "finish_reason": finish}
                 obj = "chat.completion.chunk"
             else:
-                choice = {"index": 0, "text": piece, "finish_reason": None}
+                choice = {"index": 0, "text": piece, "finish_reason": finish}
                 obj = "text_completion"
             chunk = {
                 "id": cid,
@@ -282,21 +291,74 @@ def build_app(state: ServerState) -> web.Application:
                 "choices": [choice],
             }
             await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-            if yield_final:
+
+        # Stop handling mirrors OpenAI semantics on the streamed path too:
+        # never emit the stop sequence or anything after it. Matching runs
+        # on the FULL decode of all generated tokens — concatenating
+        # per-token decodes diverges from it under BPE boundary effects
+        # (leading-space stripping), which would make streamed truncation
+        # disagree with the non-streaming path. The full re-decode per
+        # token is O(n^2) in characters, accepted on this host-side path.
+        # A match can span chunk boundaries, so when stop sequences exist
+        # the stream holds back the last max(len(stop))-1 chars until more
+        # text (or the end) proves they're not a prefix of a match.
+        max_stop = max((len(s) for s in stop), default=0) if stop else 0
+        holdback = max(0, max_stop - 1)
+        tokens: list[int] = []
+        sent = 0  # chars already streamed
+        finish_reason: Optional[str] = None
+        while True:
+            tok_id = await loop.run_in_executor(None, req.out.get)
+            if tok_id is None:
+                full = state.tokenizer.decode(tokens)
+                if stop and (cut := _find_stop(full, stop)) is not None:
+                    full, finish_reason = full[:cut], "stop"
+                elif state.engine.error is not None and not tokens:
+                    # The engine died before producing anything: the stream
+                    # is already committed (200), but a fabricated "stop"
+                    # would be indistinguishable from an instant EOS.
+                    finish_reason = "error"
+                else:
+                    finish_reason = req.finish_reason
+                if len(full) > sent:
+                    await write_piece(full[sent:])
                 break
-        done_choice = (
-            {"index": 0, "delta": {}, "finish_reason": req.finish_reason}
-            if chat
-            else {"index": 0, "text": "", "finish_reason": req.finish_reason}
-        )
-        final = {
-            "id": cid,
-            "object": "chat.completion.chunk" if chat else "text_completion",
-            "created": created,
-            "model": state.model_name,
-            "choices": [done_choice],
-        }
-        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            tokens.append(tok_id)
+            full = state.tokenizer.decode(tokens)
+            if stop:
+                # A new match must end inside the unsent tail (plus the
+                # holdback window) — search only there.
+                base = max(0, sent - holdback)
+                cut = _find_stop(full[base:], stop)
+                if cut is not None:
+                    cut += base
+                    if cut > sent:
+                        await write_piece(full[sent:cut])
+                        sent = cut
+                    req.cancelled = True
+                    while (
+                        await loop.run_in_executor(None, req.out.get)
+                        is not None
+                    ):
+                        pass
+                    finish_reason = "stop"
+                    break
+            # Hold back the stop window plus any trailing partial UTF-8
+            # codepoint (<= 3 replacement chars; a longer run is genuinely
+            # invalid output and streams as-is).
+            emit_to = len(full) - holdback
+            trail = 0
+            while (
+                trail < 3
+                and emit_to - 1 - trail >= 0
+                and full[emit_to - 1 - trail] == "�"
+            ):
+                trail += 1
+            emit_to -= trail if trail < 3 else 0
+            if emit_to > sent:
+                await write_piece(full[sent:emit_to])
+                sent = emit_to
+        await write_piece("", finish_reason)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp

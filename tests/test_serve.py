@@ -1,6 +1,7 @@
 """Serving engine + HTTP contract tests (reference analogue: test/system.sh's
 curl of /v1/completions and the `GET /` readiness contract,
 docs/container-contract.md:50-56)."""
+import json
 import threading
 
 import jax
@@ -197,6 +198,93 @@ def test_http_completions(engine):
             assert r.status == 400
             r = await client.post("/debug/profile", json=[1])
             assert r.status == 400
+
+    asyncio.run(go())
+
+
+def test_http_streaming_stop_and_knob_validation(engine):
+    """The SSE path must honor `stop` exactly like the non-streaming path:
+    truncate before the match, cancel the engine slot, finish_reason
+    "stop" — and never emit the stop sequence even when it spans chunks."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.serve.server import ServerState, build_app
+
+    state = ServerState(engine, ByteTokenizer(), "tiny")
+
+    async def read_stream(client, payload):
+        r = await client.post("/v1/completions", json=payload)
+        assert r.status == 200
+        text, finish = "", None
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[len("data: "):])
+            choice = chunk["choices"][0]
+            text += choice.get("text", "")
+            if choice["finish_reason"] is not None:
+                finish = choice["finish_reason"]
+        return text, finish
+
+    async def go():
+        app = build_app(state)
+        async with TestClient(TestServer(app)) as client:
+            # Oracle: the non-streaming full text.
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hi", "max_tokens": 10, "temperature": 0.0},
+            )
+            full_text = (await r.json())["choices"][0]["text"]
+            assert len(full_text) >= 3
+
+            # No stop: the stream reassembles the exact full text.
+            text, finish = await read_stream(
+                client,
+                {"prompt": "hi", "max_tokens": 10, "temperature": 0.0,
+                 "stream": True},
+            )
+            assert text == full_text
+            assert finish == "length"
+
+            # Stop on a mid-text char: truncated before it, engine slot
+            # cancelled early, finish_reason "stop".
+            stop = full_text[2]
+            text, finish = await read_stream(
+                client,
+                {"prompt": "hi", "max_tokens": 40, "temperature": 0.0,
+                 "stream": True, "stop": stop},
+            )
+            assert stop not in text
+            assert full_text.startswith(text)
+            assert finish == "stop"
+
+            # Multi-char stop spanning chunk boundaries is held back whole.
+            stop2 = full_text[1:4]
+            text, finish = await read_stream(
+                client,
+                {"prompt": "hi", "max_tokens": 40, "temperature": 0.0,
+                 "stream": True, "stop": [stop2]},
+            )
+            assert stop2 not in text
+            assert text == full_text[:1]
+            assert finish == "stop"
+
+            # Knob ranges reject up front, streaming or not.
+            for bad in (
+                {"max_tokens": 0},
+                {"temperature": -0.5},
+                {"temperature": float("nan")},
+                {"top_p": 0},
+                {"top_p": 1.5},
+                {"top_p": float("nan")},
+            ):
+                r = await client.post(
+                    "/v1/completions", json={"prompt": "hi", **bad}
+                )
+                assert r.status == 400, bad
 
     asyncio.run(go())
 

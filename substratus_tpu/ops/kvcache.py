@@ -50,7 +50,15 @@ def paged_update_and_read(
     def flat(a):
         return a.reshape((pages * bs,) + a.shape[2:])
 
-    pid = jnp.take_along_axis(block_table, positions // bs, axis=1)
+    # Writes past the block table's reach (speculative verify near the
+    # context window) are redirected to the trash page (physical page 0)
+    # instead of silently aliasing the last page via index clamping.
+    page_idx = positions // bs
+    oob = page_idx >= m
+    pid = jnp.take_along_axis(
+        block_table, jnp.minimum(page_idx, m - 1), axis=1
+    )
+    pid = jnp.where(oob, 0, pid)
     idx = pid * bs + positions % bs  # [B, S] flat token index
     ctx_idx = (
         block_table[:, :, None] * bs

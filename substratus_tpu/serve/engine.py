@@ -57,6 +57,14 @@ class EngineConfig:
     # if the pool runs dry mid-decode.
     kv_pool_tokens: Optional[int] = None
     prefix_cache: bool = True  # share full prompt-prefix pages across requests
+    # Speculative decoding (paged layout only): the draft model proposes
+    # spec_k greedy tokens per iteration and ONE target forward verifies all
+    # of them — decode is HBM-bound, so accepted tokens amortize the weight
+    # stream. Greedy slots stay token-exact (longest matching prefix +
+    # correction); sampling slots take the verify pass's position-0 sample
+    # (one token, plain-decode semantics). 0 = off; requires draft= at
+    # Engine construction.
+    spec_k: int = 0
 
 
 @dataclass
@@ -103,6 +111,7 @@ class Engine:
         ec: Optional[EngineConfig] = None,
         mesh=None,
         model=llama,
+        draft: Optional[tuple] = None,  # (draft_cfg, draft_params)
     ):
         """model: the model-family module (models.llama, models.opt, ...)
         implementing forward/init_cache/param_logical_axes/cache_logical_axes.
@@ -243,7 +252,52 @@ class Engine:
             "preemptions": 0,
             "truncated_by_pool": 0,
             "max_active": 0,
+            "verify_passes": 0,
+            "spec_proposed": 0,
+            "spec_accepted": 0,
         }
+
+        # Speculative decoding state. The draft pool shares the target's
+        # block tables and page allocation: identical page ids index both
+        # pools, and prefix-shared pages hold identical draft KV because
+        # shared prefixes are identical prompts (draft prefill always runs
+        # over the full prompt, so reused target pages regain their draft
+        # entries too).
+        if ec.spec_k < 0:
+            raise ValueError(f"spec_k {ec.spec_k} invalid")
+        if ec.spec_k and draft is None:
+            raise ValueError("spec_k requires a draft=(cfg, params) model")
+        self.spec = bool(ec.spec_k)
+        if self.spec and not self.paged:
+            raise ValueError("spec_k requires the paged kv layout")
+        if self.spec:
+            self.draft_cfg, draft_params = draft
+            self.draft_params = draft_params
+            if mesh is not None:
+                from substratus_tpu.parallel.sharding import (
+                    SERVE_RULES,
+                    shard_tree,
+                )
+
+                self.draft_params = shard_tree(
+                    draft_params, mesh,
+                    model.param_logical_axes(self.draft_cfg), SERVE_RULES,
+                )
+            # Same KV dtype as the target pool: an int8 configuration means
+            # int8 for the draft's (larger-per-token-count) traffic too.
+            draft_pool = model.init_paged_cache(
+                self.draft_cfg, self.n_pages + 1, self.page_size,
+                dtype=cache_dtype,
+            )
+            if mesh is not None:
+                draft_pool = shard_tree(
+                    draft_pool, mesh,
+                    model.paged_cache_logical_axes(
+                        self.draft_cfg, quantized=kv_int8
+                    ),
+                    SERVE_RULES,
+                )
+            self.draft_cache = draft_pool
 
         self.queue: "queue.Queue[Request]" = queue.Queue()
         self._stop = threading.Event()
@@ -253,6 +307,14 @@ class Engine:
 
         self._decode_fn = self._build_decode()
         self._chunk_fn = partial(self._chunk_prefill_jit, self.model, self.cfg)
+        if self.spec:
+            self._draft_chunk_fn = partial(
+                self._chunk_prefill_jit, self.model, self.draft_cfg
+            )
+            self._propose_fn = partial(
+                self._propose_jit, self.model, self.draft_cfg, self.ec.spec_k
+            )
+            self._verify_fn = self._build_verify()
         if not self.paged:
             self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
             self._insert_fn = self._build_insert()
@@ -292,6 +354,54 @@ class Engine:
             params, tokens, cfg, positions=positions, cache=slot_cache, **kw
         )
         return logits[0, true_len - 1], slot_cache
+
+    @staticmethod
+    @partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4,))
+    def _propose_jit(model, cfg, k, params, cache, block_table, tokens,
+                     positions):
+        """Draft k greedy tokens for the whole batch: k cheap decode steps
+        through the draft's paged pool. Returns (proposals [B, k], cache)."""
+
+        def step(carry, _):
+            cache, tok, pos = carry
+            logits, cache = model.forward(
+                params, tok[:, None], cfg, positions=pos[:, None],
+                cache=cache, block_table=block_table,
+            )
+            nxt = logits[:, 0].argmax(-1).astype(jnp.int32)
+            return (cache, nxt, pos + 1), nxt
+
+        (cache, _, _), props = jax.lax.scan(
+            step, (cache, tokens, positions), None, length=k
+        )
+        return jnp.swapaxes(props, 0, 1), cache  # [B, k]
+
+    def _build_verify(self):
+        cfg, ec, model = self.cfg, self.ec, self.model
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def verify(params, cache, block_table, block_tokens, positions0,
+                   temps, top_ps, key):
+            """ONE target forward over [last, d1..dk] per slot ([B, k+1]).
+            Returns (greedy choices [B, k+1], position-0 samples [B] for
+            sampling slots, cache, key)."""
+            s = block_tokens.shape[1]
+            positions = (
+                positions0[:, None]
+                + jnp.arange(s, dtype=jnp.int32)[None, :]
+            )
+            logits, cache = model.forward(
+                params, block_tokens, cfg, positions=positions, cache=cache,
+                block_table=block_table,
+            )
+            choices = logits.argmax(-1).astype(jnp.int32)
+            key, subkey = jax.random.split(key)
+            sampled = sample(
+                logits[:, 0], subkey, temps, top_k=ec.top_k, top_p=top_ps
+            )
+            return choices, sampled, cache, key
+
+        return verify
 
     def _build_slot_io(self):
         @jax.jit
@@ -365,6 +475,7 @@ class Engine:
 
     def submit(self, req: Request) -> Request:
         if self.error is not None:
+            req.finish_reason = "error"
             req.out.put(None)  # engine is dead; never strand the caller
             return req
         self.queue.put(req)
@@ -374,6 +485,7 @@ class Engine:
             # stranding the request. error is always set BEFORE the drain,
             # so re-checking here guarantees a terminal marker either way
             # (a duplicate None in a dead request's queue is harmless).
+            req.finish_reason = "error"
             req.out.put(None)
         return req
 
@@ -505,26 +617,44 @@ class Engine:
         self.block_table = self.block_table.at[slot].set(jnp.asarray(row))
         bt_row = self.block_table[slot : slot + 1]
 
-        chunk = self.ec.max_prefill_len
-        offset = reuse
-        last_logits = None
-        while offset < true_len:
-            padded, clen = _pad_to_bucket(
-                prompt[offset : offset + chunk], chunk
-            )
-            last_logits, self.cache = self._chunk_fn(
-                self.params, self.cache, padded, offset, clen,
-                block_table=bt_row,
-            )
-            offset += clen
+        last_logits, self.cache = self._run_chunks(
+            self._chunk_fn, self.params, self.cache, prompt, reuse, bt_row
+        )
         self.stats["prefill_tokens"] += true_len - reuse
         self.stats["prefix_hit_tokens"] += reuse
+
+        if self.spec:
+            # Draft prefill also starts at `reuse`: the draft pool indexes
+            # through the same block table, and shared pages already hold
+            # valid draft KV — registered pages are only ever written during
+            # the admission that created them (decode/propose writes land at
+            # positions >= true_len, past every registered full page), so
+            # the invariant holds inductively from the first admission.
+            _, self.draft_cache = self._run_chunks(
+                self._draft_chunk_fn, self.draft_params, self.draft_cache,
+                prompt, reuse, bt_row,
+            )
 
         n_full = true_len // bs
         if self.prefix is not None and n_full:
             self.prefix.register(entries[:n_full], pages[:n_full])
         self._finalize_admit(req, slot, last_logits, true_len)
         return True
+
+    def _run_chunks(self, fn, params, cache, prompt, start: int, bt_row):
+        """Chunked prefill of prompt[start:] through a block-table row;
+        returns (last real token's logits, updated cache)."""
+        chunk = self.ec.max_prefill_len
+        offset, last_logits = start, None
+        while offset < len(prompt):
+            padded, clen = _pad_to_bucket(
+                prompt[offset : offset + chunk], chunk
+            )
+            last_logits, cache = fn(
+                params, cache, padded, offset, clen, block_table=bt_row
+            )
+            offset += clen
+        return last_logits, cache
 
     def _finalize_admit(self, req: Request, slot: int, last_logits,
                         true_len: int) -> None:
@@ -595,31 +725,133 @@ class Engine:
         self._resume.insert(0, req)
         self.stats["preemptions"] += 1
 
-    def _ensure_capacity(self, slot: int) -> None:
-        """Before a decode step writes at host_positions[slot], make sure
-        the page backing that position exists — allocating, evicting
-        prefix entries, then preempting the youngest other slot, in that
-        order. Last resort (single survivor, pool exhausted): finish the
-        request as truncated."""
+    def _ensure_capacity(self, slot: int, upto_pos: Optional[int] = None) -> None:
+        """Before this iteration writes at positions up to `upto_pos`
+        (default: the next decode write, host_positions[slot]), make sure
+        the pages backing them exist — allocating, evicting prefix entries,
+        then preempting the youngest other slot, in that order. Last resort
+        (single survivor, pool exhausted): finish the request as truncated.
+        Writes beyond max_seq_len never need pages (the paged kernel
+        redirects past-the-table writes to the trash page)."""
         if not self.active[slot]:
             return  # preempted earlier in this same pass
-        pn = int(self.host_positions[slot]) // self.page_size
-        if pn < len(self.slot_pages.pages[slot]):
-            return
-        got = self._try_alloc(1)
-        while got is None:
-            victim = self._pick_victim(exclude=slot)
-            if victim is None:
-                req = self.slot_req[slot]
-                req.finish_reason = "length"
-                req.out.put(None)
-                self._release_slot(slot)
-                self.stats["truncated_by_pool"] += 1
-                return
-            self._preempt(victim)
+        if upto_pos is None:
+            upto_pos = int(self.host_positions[slot])
+        upto_pos = min(upto_pos, self.ec.max_seq_len - 1)
+        while upto_pos // self.page_size >= len(self.slot_pages.pages[slot]):
+            pn = len(self.slot_pages.pages[slot])
             got = self._try_alloc(1)
-        self.slot_pages.append(slot, got[0])
-        self.block_table = self.block_table.at[slot, pn].set(got[0])
+            while got is None:
+                victim = self._pick_victim(exclude=slot)
+                if victim is None:
+                    req = self.slot_req[slot]
+                    req.finish_reason = "length"
+                    req.out.put(None)
+                    self._release_slot(slot)
+                    self.stats["truncated_by_pool"] += 1
+                    return
+                self._preempt(victim)
+                got = self._try_alloc(1)
+            self.slot_pages.append(slot, got[0])
+            self.block_table = self.block_table.at[slot, pn].set(got[0])
+
+    def _decode_step(self) -> None:
+        """One plain decode iteration: every active slot advances a token."""
+        if self.paged:
+            # Grow every slot that will cross a page boundary this step
+            # (may preempt or, at the limit, truncate).
+            for slot in np.flatnonzero(self.active):
+                self._ensure_capacity(int(slot))
+            if not self.active.any():
+                return
+        next_tokens, self.cache, self.key = self._decode_fn(
+            self.params,
+            self.cache,
+            self.block_table if self.paged else None,
+            self.tokens,
+            self.positions,
+            self.temps,
+            self.top_ps,
+            self.key,
+        )
+        self.positions = self.positions + 1
+        self.host_positions += 1
+        self.tokens = next_tokens
+        host_tokens = np.asarray(next_tokens)
+        for slot in np.flatnonzero(self.active):
+            self._emit(int(slot), int(host_tokens[slot]))
+
+    def _spec_step(self) -> None:
+        """One speculative iteration for the whole batch: draft proposes
+        spec_k tokens, one target forward verifies k+1 positions. Greedy
+        slots emit the longest matching prefix (+ the target's correction
+        on a mismatch) — token-exact vs plain decode; sampling slots emit
+        the verify pass's position-0 sample. Cache staleness beyond the
+        accepted point is safe: causal masking never reads past the query
+        position, and the next round rewrites exactly those slots."""
+        k = self.ec.spec_k
+        # Speculation only pays off for greedy slots; an all-sampling batch
+        # would do k draft steps + a (k+1)-wide verify to emit one token
+        # per slot — strictly worse than one plain decode step.
+        if not any(
+            self.slot_req[int(s)].temperature == 0.0
+            for s in np.flatnonzero(self.active)
+        ):
+            self._decode_step()
+            return
+        for slot in np.flatnonzero(self.active):
+            self._ensure_capacity(
+                int(slot), int(self.host_positions[slot]) + k
+            )
+        if not self.active.any():
+            return
+        proposals, self.draft_cache = self._propose_fn(
+            self.draft_params, self.draft_cache, self.block_table,
+            self.tokens, self.positions,
+        )
+        block = jnp.concatenate([self.tokens[:, None], proposals], axis=1)
+        choices, sampled, self.cache, self.key = self._verify_fn(
+            self.params, self.cache, self.block_table, block,
+            self.positions, self.temps, self.top_ps, self.key,
+        )
+        self.stats["verify_passes"] += 1
+
+        props = np.asarray(proposals)
+        chs = np.asarray(choices)
+        smp = np.asarray(sampled)
+        next_tokens = np.asarray(self.tokens).copy()
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            req = self.slot_req[slot]
+            if req.temperature != 0.0:
+                emit_list = [int(smp[slot])]
+            else:
+                accepted = 0
+                while (
+                    accepted < k
+                    and props[slot, accepted] == chs[slot, accepted]
+                ):
+                    accepted += 1
+                self.stats["spec_proposed"] += k
+                self.stats["spec_accepted"] += accepted
+                if accepted == k:
+                    # Full acceptance: no bonus token — the draft never
+                    # wrote the last proposal's kv, so it must seed the
+                    # next round (both caches stay hole-free).
+                    emit_list = [int(x) for x in props[slot]]
+                else:
+                    emit_list = [int(x) for x in props[slot, :accepted]]
+                    emit_list.append(int(chs[slot, accepted]))
+            next_tokens[slot] = emit_list[-1]
+            for tok in emit_list:
+                self.host_positions[slot] += 1
+                self._emit(slot, tok)
+                if not self.active[slot]:
+                    break
+        self.tokens = jnp.asarray(next_tokens)
+        self.positions = jnp.asarray(
+            self.host_positions.astype(np.int32)
+        )
 
     def _release_slot(self, slot: int) -> None:
         self.active[slot] = False
@@ -674,42 +906,32 @@ class Engine:
         try:
             while not self._stop.is_set():
                 self._admit()
-                if self.paged:
-                    # Grow every slot that will cross a page boundary this
-                    # step (may preempt or, at the limit, truncate).
-                    for slot in np.flatnonzero(self.active):
-                        self._ensure_capacity(int(slot))
                 if not self.active.any():
                     time.sleep(0.002)
                     continue
-                next_tokens, self.cache, self.key = self._decode_fn(
-                    self.params,
-                    self.cache,
-                    self.block_table if self.paged else None,
-                    self.tokens,
-                    self.positions,
-                    self.temps,
-                    self.top_ps,
-                    self.key,
-                )
-                self.positions = self.positions + 1
-                self.host_positions += 1
-                self.tokens = next_tokens
-                host_tokens = np.asarray(next_tokens)
-                for slot in np.flatnonzero(self.active):
-                    self._emit(int(slot), int(host_tokens[slot]))
+                if self.spec:
+                    self._spec_step()
+                else:
+                    self._decode_step()
         except BaseException as e:  # propagate to waiting callers
             self.error = e
+
+            def kill(req: Request) -> None:
+                # "error", not the "stop" default: consumers must be able
+                # to tell an engine crash from a clean EOS.
+                req.finish_reason = "error"
+                req.out.put(None)
+
             if self._admitting is not None:
-                self._admitting.out.put(None)
+                kill(self._admitting)
             for req in self.slot_req:
                 if req is not None:
-                    req.out.put(None)
+                    kill(req)
             for req in self._resume:
-                req.out.put(None)
+                kill(req)
             while not self.queue.empty():
                 try:
-                    self.queue.get_nowait().out.put(None)
+                    kill(self.queue.get_nowait())
                 except queue.Empty:
                     break
             raise

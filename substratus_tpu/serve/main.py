@@ -40,6 +40,14 @@ def main(argv=None) -> int:
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument("--quantize", default=None, choices=["int8", "none"])
+    ap.add_argument(
+        "--draft-model", default=None,
+        help="draft checkpoint dir for speculative decoding",
+    )
+    ap.add_argument(
+        "--spec-k", type=int, default=None,
+        help="draft tokens proposed per verify pass (0 = off)",
+    )
     args = ap.parse_args(argv)
 
     from substratus_tpu.utils.jaxenv import honor_requested_platform
@@ -58,7 +66,7 @@ def main(argv=None) -> int:
         (
             "model", "config", "quantize", "max_batch", "max_seq_len",
             "max_prefill_len", "kv_cache_dtype", "attn_impl", "tensor",
-            "replicas",
+            "replicas", "draft_model", "spec_k",
         ),
         "serve.main",
     )
@@ -140,7 +148,45 @@ def main(argv=None) -> int:
         if max_batch % (n_dev // tp):
             ec.max_batch = ((max_batch // (n_dev // tp)) + 1) * (n_dev // tp)
         print(f"serving mesh: data={n_dev // tp} tensor={tp}", flush=True)
-    engine = Engine(cfg, params, ec, mesh=mesh, model=family)
+    # Speculative decoding: a small draft model (same family) proposes,
+    # the target verifies — engine-integrated, batched (serve/engine.py).
+    draft = None
+    draft_dir = args.draft_model or params_json.get("draft_model")
+    spec_k = (
+        args.spec_k
+        if args.spec_k is not None
+        else int(params_json.get("spec_k", 0))
+    )
+    if draft_dir and spec_k:
+        from substratus_tpu.train.checkpoints import maybe_restore_orbax
+
+        restored = maybe_restore_orbax(draft_dir)
+        if restored is not None:
+            draft_cfg, draft_params = restored
+        else:
+            from substratus_tpu.load.hf import load_pretrained
+
+            draft_cfg, draft_params = load_pretrained(draft_dir)
+        if registry.module_of(draft_cfg) is not family:
+            raise SystemExit("draft model must be the same family as the target")
+        if quantize == "int8" and family is llama:
+            from substratus_tpu.ops.quant import is_quantized, quantize_params
+
+            if not is_quantized(draft_params):
+                # The draft must ride the same quantization as the target —
+                # it exists to cut HBM traffic, not to add bf16 streams.
+                draft_params = jax.jit(
+                    lambda p: quantize_params(
+                        p, llama.quant_contracting(draft_cfg)
+                    )
+                )(draft_params)
+        draft = (draft_cfg, draft_params)
+        ec.spec_k = spec_k
+        print(f"speculative decoding: draft={draft_dir} k={spec_k}", flush=True)
+    elif spec_k:
+        print("spec_k set but no draft model; speculation disabled", flush=True)
+
+    engine = Engine(cfg, params, ec, mesh=mesh, model=family, draft=draft)
     engine.start()
     state = ServerState(engine, tokenizer, model_name)
     print(f"serving {model_name} on {args.host}:{args.port}", flush=True)

@@ -165,6 +165,14 @@ def build_app(state: ServerState) -> web.Application:
             f"substratus_serve_max_slots {eng.ec.max_batch}",
             f"substratus_serve_queue_depth {eng.queue.qsize()}",
         ]
+        lines += [
+            f"substratus_serve_{k} {v}" for k, v in sorted(eng.stats.items())
+        ]
+        if getattr(eng, "paged", False):
+            lines += [
+                f"substratus_serve_kv_pages_total {eng.n_pages}",
+                f"substratus_serve_kv_pages_free {eng.alloc.free_pages}",
+            ]
         return web.Response(
             text="\n".join(lines) + "\n",
             content_type="text/plain",
@@ -313,12 +321,10 @@ def build_app(state: ServerState) -> web.Application:
                 full = state.tokenizer.decode(tokens)
                 if stop and (cut := _find_stop(full, stop)) is not None:
                     full, finish_reason = full[:cut], "stop"
-                elif state.engine.error is not None and not tokens:
-                    # The engine died before producing anything: the stream
-                    # is already committed (200), but a fabricated "stop"
-                    # would be indistinguishable from an instant EOS.
-                    finish_reason = "error"
                 else:
+                    # The engine reports "error" on the request itself when
+                    # its thread died mid-stream — the committed 200 stream
+                    # then ends honestly instead of fabricating "stop".
                     finish_reason = req.finish_reason
                 if len(full) > sent:
                     await write_piece(full[sent:])

@@ -14,8 +14,11 @@ causal mask (q_positions) never reads a slot beyond the current query
 position, and the next round rewrites exactly those positions with the
 accepted tokens.
 
-Per-request API (llama-family); engine-integrated batched speculation is a
-future round.
+This module is the standalone per-request API (llama-family) and the
+numerical reference for acceptance semantics. Production serving uses the
+ENGINE-INTEGRATED batched speculation: Engine(..., draft=(cfg, params)) with
+EngineConfig.spec_k > 0 (serve/engine.py::_spec_step) — same greedy
+acceptance rule, whole-batch proposals, paged KV on both models.
 """
 from __future__ import annotations
 

@@ -307,6 +307,9 @@ def _moe_ffn(
     lp: Params,
     cfg: LlamaConfig,
     train: bool,
+    lora: Optional[Params] = None,  # per-layer adapters (may hold expert-
+    # routed pairs a [E, in, r] / b [E, r, out], train/lora.py)
+    lora_scale: float = 1.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Routed top-k expert FFN (Mixtral-style).
 
@@ -328,6 +331,17 @@ def _moe_ffn(
     dt = cfg.dtype
     b, s, d = h.shape
     E, k = cfg.n_experts, cfg.n_experts_per_token
+    lora = lora or {}
+
+    def eproj(name, x, eq_w, eq_a, eq_b):
+        """Per-expert projection with optional expert-routed LoRA delta."""
+        out = jnp.einsum(eq_w, x, materialize(lp[name], dt))
+        if name in lora:
+            down = jnp.einsum(eq_a, x, lora[name]["a"].astype(dt))
+            out = out + jnp.einsum(
+                eq_b, down, lora[name]["b"].astype(dt)
+            ) * lora_scale
+        return out
 
     logits = jnp.einsum(
         "bsd,de->bse", h.astype(jnp.float32),
@@ -351,11 +365,12 @@ def _moe_ffn(
             * top_w[..., None],
             axis=2,
         )
-        gate = jnp.einsum("bsd,edm->bsem", h, materialize(lp["w_gate"], dt))
-        up = jnp.einsum("bsd,edm->bsem", h, materialize(lp["w_up"], dt))
-        out = jnp.einsum(
-            "bsem,emd->bsed", swiglu(gate, up), materialize(lp["w_down"], dt)
-        )
+        gate = eproj("w_gate", h, "bsd,edm->bsem", "bsd,edr->bser",
+                     "bser,erm->bsem")
+        up = eproj("w_up", h, "bsd,edm->bsem", "bsd,edr->bser",
+                   "bser,erm->bsem")
+        out = eproj("w_down", swiglu(gate, up), "bsem,emd->bsed",
+                    "bsem,emr->bser", "bser,erd->bsed")
         y = jnp.einsum("bsed,bse->bsd", out, w_full.astype(dt))
         return y.astype(dt), aux
 
@@ -376,11 +391,12 @@ def _moe_ffn(
     expert_in = jnp.einsum(
         "btec,btd->ebcd", dispatch.astype(dt), h_rep
     )  # [E,B,C,D]
-    gate = jnp.einsum("ebcd,edm->ebcm", expert_in, materialize(lp["w_gate"], dt))
-    up = jnp.einsum("ebcd,edm->ebcm", expert_in, materialize(lp["w_up"], dt))
-    out = jnp.einsum(
-        "ebcm,emd->ebcd", swiglu(gate, up), materialize(lp["w_down"], dt)
-    )
+    gate = eproj("w_gate", expert_in, "ebcd,edm->ebcm", "ebcd,edr->ebcr",
+                 "ebcr,erm->ebcm")
+    up = eproj("w_up", expert_in, "ebcd,edm->ebcm", "ebcd,edr->ebcr",
+               "ebcr,erm->ebcm")
+    out = eproj("w_down", swiglu(gate, up), "ebcm,emd->ebcd",
+                "ebcm,emr->ebcr", "ebcr,erd->ebcd")
     y = jnp.einsum("ebcd,btec->btd", out, combine.astype(dt))  # [B,T,D]
     y = y.reshape(b, s, k, d).sum(axis=2)
     return y.astype(dt), aux
@@ -475,7 +491,7 @@ def _block(
     x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
-        y, aux = _moe_ffn(h, lp, cfg, train)
+        y, aux = _moe_ffn(h, lp, cfg, train, lora, lora_scale)
         x = x + y
     else:
         gate = proj("w_gate", h, "bsd,dm->bsm", "bsr,rm->bsm")

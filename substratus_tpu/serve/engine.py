@@ -868,18 +868,10 @@ class Engine:
         """Prefill a prompt longer than one bucket: run bucket-sized chunks
         against the slot's cache (each chunk attends everything before it),
         then restore the slot into the decode cache."""
-        chunk = self.ec.max_prefill_len
         slot_cache = self._extract_slot(self.cache, slot)
-        last_logits = None
-        offset = 0
-        while offset < len(prompt):
-            padded, true_len = _pad_to_bucket(
-                prompt[offset : offset + chunk], chunk
-            )
-            last_logits, slot_cache = self._chunk_fn(
-                self.params, slot_cache, padded, offset, true_len
-            )
-            offset += true_len
+        last_logits, slot_cache = self._run_chunks(
+            self._chunk_fn, self.params, slot_cache, prompt, 0, None
+        )
         self.cache = self._restore_slot(self.cache, slot_cache, slot)
         return last_logits
 

@@ -82,16 +82,20 @@ def main(argv=None) -> int:
     from substratus_tpu.serve.server import ServerState, serve_forever
     from substratus_tpu.serve.tokenizer import load_tokenizer
 
-    if model_dir:
+    def load_checkpoint(path: str):
+        """Orbax artifact if present, else HF layout — one resolution rule
+        for target and draft models alike."""
         from substratus_tpu.train.checkpoints import maybe_restore_orbax
 
-        restored = maybe_restore_orbax(model_dir)
+        restored = maybe_restore_orbax(path)
         if restored is not None:
-            cfg, params = restored
-        else:
-            from substratus_tpu.load.hf import load_pretrained
+            return restored
+        from substratus_tpu.load.hf import load_pretrained
 
-            cfg, params = load_pretrained(model_dir)
+        return load_pretrained(path)
+
+    if model_dir:
+        cfg, params = load_checkpoint(model_dir)
         model_name = os.path.basename(os.path.normpath(model_dir))
         tokenizer = load_tokenizer(model_dir)
     else:
@@ -158,15 +162,7 @@ def main(argv=None) -> int:
         else int(params_json.get("spec_k", 0))
     )
     if draft_dir and spec_k:
-        from substratus_tpu.train.checkpoints import maybe_restore_orbax
-
-        restored = maybe_restore_orbax(draft_dir)
-        if restored is not None:
-            draft_cfg, draft_params = restored
-        else:
-            from substratus_tpu.load.hf import load_pretrained
-
-            draft_cfg, draft_params = load_pretrained(draft_dir)
+        draft_cfg, draft_params = load_checkpoint(draft_dir)
         if registry.module_of(draft_cfg) is not family:
             raise SystemExit("draft model must be the same family as the target")
         if quantize == "int8" and family is llama:

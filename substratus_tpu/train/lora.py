@@ -47,23 +47,32 @@ def init_lora(
         "w_gate": cfg.dim, "w_up": cfg.dim,
         "w_down": cfg.hidden_dim,
     }
-    if getattr(cfg, "n_experts", 0) > 0:
-        moe_mlp = {"w_gate", "w_up", "w_down"} & set(targets)
-        if moe_mlp:
-            raise ValueError(
-                f"LoRA targets {sorted(moe_mlp)} are expert-routed on MoE "
-                f"models (n_experts={cfg.n_experts}); adapters for expert "
-                "weights are not supported yet — target attention "
-                "projections (wq/wk/wv/wo) instead"
-            )
+    moe_mlp = (
+        {"w_gate", "w_up", "w_down"}
+        if getattr(cfg, "n_experts", 0) > 0
+        else set()
+    )
     keys = jax.random.split(key, len(targets))
     layers: Dict[str, Any] = {}
     for k, name in zip(keys, targets):
-        a = (
-            jax.random.normal(k, (cfg.n_layers, in_dim[name], rank), jnp.float32)
-            * (1.0 / rank)
-        ).astype(dtype)
-        b = jnp.zeros((cfg.n_layers, rank) + out_shape[name], dtype)
+        if name in moe_mlp:
+            # Expert-routed weights carry a leading expert dim: each expert
+            # gets its own low-rank pair [L, E, in, r] x [L, E, r, out]
+            # (applied inside the routed FFN, models/llama.py::_moe_ffn).
+            E = cfg.n_experts
+            a = (
+                jax.random.normal(
+                    k, (cfg.n_layers, E, in_dim[name], rank), jnp.float32
+                ) * (1.0 / rank)
+            ).astype(dtype)
+            b = jnp.zeros((cfg.n_layers, E, rank) + out_shape[name], dtype)
+        else:
+            a = (
+                jax.random.normal(
+                    k, (cfg.n_layers, in_dim[name], rank), jnp.float32
+                ) * (1.0 / rank)
+            ).astype(dtype)
+            b = jnp.zeros((cfg.n_layers, rank) + out_shape[name], dtype)
         layers[name] = {"a": a, "b": b}
     # NOTE: the adapter-layer tree alone is returned; the (static) scale
     # alpha/rank is NOT part of the pytree so it can never receive gradients
@@ -88,8 +97,9 @@ def merge_lora(
         orig = layers[name]
         out_dtype = jnp.bfloat16 if isinstance(orig, QTensor) else orig.dtype
         w = materialize(orig, jnp.float32)
+        eq = "ledr,ler...->led..." if ab["a"].ndim == 4 else "ldr,lr...->ld..."
         delta = jnp.einsum(
-            "ldr,lr...->ld...",
+            eq,
             ab["a"].astype(jnp.float32),
             ab["b"].astype(jnp.float32),
         ) * scale
@@ -113,10 +123,17 @@ def lora_logical_axes(adapters: LoraParams) -> LoraParams:
         "w_down": ("layers", "lora_rank", "embed"),
     }
     axes_layers = {}
-    for name in adapters:
+    for name, ab in adapters.items():
         in_axis = "mlp" if name == "w_down" else "embed"
-        axes_layers[name] = {
-            "a": ("layers", in_axis, "lora_rank"),
-            "b": out_axes[name],
-        }
+        if ab["a"].ndim == 4:  # expert-routed adapter (MoE mlp)
+            out_axis = "embed" if name == "w_down" else "mlp"
+            axes_layers[name] = {
+                "a": ("layers", "expert", in_axis, "lora_rank"),
+                "b": ("layers", "expert", "lora_rank", out_axis),
+            }
+        else:
+            axes_layers[name] = {
+                "a": ("layers", in_axis, "lora_rank"),
+                "b": out_axes[name],
+            }
     return axes_layers

@@ -52,6 +52,9 @@ class TrainConfig:
     # LoRA: rank 0 disables (full finetune)
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Projections to adapt (train/lora.py); on MoE models the mlp names
+    # (w_gate/w_up/w_down) select expert-routed adapters.
+    lora_targets: tuple = ("wq", "wv")
     remat: bool = True
     seed: int = 0
     # Gradient accumulation: the global batch splits into this many
@@ -153,7 +156,8 @@ class Trainer:
             )
         if tc.lora_rank > 0:
             adapters = lora_lib.init_lora(
-                cfg, key_lora, rank=tc.lora_rank, alpha=tc.lora_alpha
+                cfg, key_lora, rank=tc.lora_rank, alpha=tc.lora_alpha,
+                targets=tuple(tc.lora_targets),
             )
             self.lora_scale = tc.lora_alpha / tc.lora_rank
             # Shape-aware (like params): MQA kv adapters replicate rather

@@ -74,3 +74,70 @@ def test_moe_capacity_drops_gracefully():
     tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
     logits, _ = llama.forward(params, tokens, cfg)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_moe_expert_lora(moe_cfg):
+    """Expert-routed LoRA (VERDICT r1 item 8): adapters on w_gate/w_up/
+    w_down carry a leading expert dim, zero-init B leaves the base model
+    unchanged, and adapter-only training moves the loss over an expert-
+    parallel mesh."""
+    from substratus_tpu.train import lora as lora_lib
+
+    params = llama.init_params(moe_cfg, jax.random.key(0))
+    adapters = lora_lib.init_lora(
+        moe_cfg, jax.random.key(1), rank=4,
+        targets=("wq", "wv", "w_gate", "w_up", "w_down"),
+    )
+    E = moe_cfg.n_experts
+    assert adapters["w_gate"]["a"].shape == (
+        moe_cfg.n_layers, E, moe_cfg.dim, 4
+    )
+    assert adapters["w_down"]["b"].shape == (
+        moe_cfg.n_layers, E, 4, moe_cfg.dim
+    )
+
+    tokens = jax.random.randint(
+        jax.random.key(2), (2, 16), 0, moe_cfg.vocab_size
+    )
+    base, _ = llama.forward(params, tokens, moe_cfg)
+    with_lora, _ = llama.forward(
+        params, tokens, moe_cfg, lora={"layers": adapters, "scale": 2.0}
+    )
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(with_lora), atol=1e-5
+    )  # B is zero-init: adapters start as identity
+
+    mesh = build_mesh(data=4, expert=2)
+    tc = TrainConfig(
+        learning_rate=5e-3, total_steps=10, warmup_steps=1, remat=False,
+        lora_rank=4,
+        lora_targets=("wq", "wv", "w_gate", "w_up", "w_down"),
+    )
+    trainer = Trainer(moe_cfg, tc, mesh, params=params)
+    spec = str(trainer.lora["w_gate"]["a"].sharding.spec)
+    assert "expert" in spec, spec
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(
+            0, moe_cfg.vocab_size, size=(4, 32)
+        ).astype(np.int32),
+        "weights": np.ones((4, 32), np.float32),
+    }
+    first = trainer.train_step(batch)
+    for _ in range(9):
+        last = trainer.train_step(batch)
+    assert np.isfinite(last)
+    assert last < first  # adapters are actually learning
+    # The base expert weights never moved (adapter-only training).
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(trainer.params["layers"]["w_gate"])),
+        np.asarray(jax.device_get(params["layers"]["w_gate"])),
+    )
+
+    # merge_lora folds the expert deltas back into [L, E, D, M] weights.
+    merged = lora_lib.merge_lora(
+        trainer.params, trainer.lora, trainer.lora_scale
+    )
+    assert merged["layers"]["w_gate"].shape == (
+        moe_cfg.n_layers, E, moe_cfg.dim, moe_cfg.hidden_dim
+    )

@@ -112,27 +112,56 @@ def _norm_kind(kind: str) -> str:
     return k
 
 
-def _wait_ready(client, kind, ns, name, timeout=720, fake=False):
+# CR kind -> the workload object it owns (shared by `sub logs` and the
+# TUI's log stage — one map, or the two drift).
+WORKLOAD_SUFFIX = {
+    "Dataset": "-data-loader",
+    "Model": "-modeller",
+    "Notebook": "-notebook",
+    "Server": "-server",
+}
+
+
+def _wait_ready(client, kind, ns, name, timeout=720, fake=False,
+                on_status=None):
     """Poll status.ready (reference client.go:114-135 WaitReady; the 720s
-    budget mirrors test/system.sh:53-54)."""
+    budget mirrors test/system.sh:53-54). on_status replaces line printing
+    (the TUI spinner narrates through it)."""
     t0 = time.time()
     last_msg = ""
     while time.time() - t0 < timeout:
         if fake and _FAKE_ENV is not None:
             _FAKE_ENV.step()
         obj = client.get_or_none(kind, ns, name)
-        if obj and obj.get("status", {}).get("ready"):
-            print(f"{kind} {name} ready")
-            return obj
         conds = (obj or {}).get("status", {}).get("conditions", [])
         msg = "; ".join(
             f"{c['type']}={c['status']}({c.get('reason', '')})" for c in conds
         )
-        if msg != last_msg:
+        if on_status is not None:
+            if msg:
+                on_status(msg)
+        elif msg != last_msg:
             print(f"  waiting: {msg or 'no status yet'}")
             last_msg = msg
-        time.sleep(0.1 if fake else 2)
+        if obj and obj.get("status", {}).get("ready"):
+            if on_status is None:
+                print(f"{kind} {name} ready")
+            return obj
+        time.sleep(0.05 if fake else 2)
     raise SystemExit(f"timed out waiting for {kind} {name}")
+
+
+def fake_workload_status_lines(client, ns, kind, name):
+    """Fake-cluster workload inspection lines, or None if no workload
+    exists (shared by `sub logs --fake` and the TUI log stage)."""
+    workload = f"{name}{WORKLOAD_SUFFIX[kind]}"
+    for wkind in ("Job", "JobSet", "Deployment", "Pod"):
+        w = client.get_or_none(wkind, ns, workload)
+        if w is not None:
+            lines = [f"{wkind.lower()}/{workload}"]
+            lines += json.dumps(w.get("status", {}), indent=2).splitlines()
+            return lines
+    return None
 
 
 # -- commands --------------------------------------------------------------
@@ -247,15 +276,27 @@ def _tarball(directory: str):
     )
 
 
-def cmd_run(args) -> int:
-    """Upload the current dir and run it as a Dataset or Model (reference
-    internal/cli/run.go:16-104)."""
-    client = _client(args)
+class _ProgressReader:
+    """File wrapper reporting bytes read (drives the TUI upload bar)."""
+
+    def __init__(self, f, total, cb):
+        self.f, self.total, self.cb, self.sent = f, total, cb, 0
+
+    def read(self, n=-1):
+        data = self.f.read(n)
+        self.sent += len(data)
+        self.cb(self.sent, self.total)
+        return data
+
+
+def upload_context(args, client, doc, progress=None):
+    """Tar the build context, apply the CR with build.upload, wait for the
+    controller's signed URL, PUT the tarball (reference upload.go:38-192).
+    progress(done_bytes, total_bytes) drives the TUI bar; None prints the
+    plain-CLI lines. Returns the applied object."""
     tar_path, md5, md5_b64, size = _tarball(args.dir)
-    docs = _load_manifests(args.filename) if args.filename else []
-    if not docs:
-        raise SystemExit("run requires -f manifest describing the Dataset/Model")
-    doc = docs[0]
+    if progress is not None:
+        progress(0, size)
     request_id = uuid.uuid4().hex
     doc.setdefault("metadata", {}).setdefault("namespace", args.namespace)
     doc.setdefault("spec", {})["build"] = {
@@ -264,7 +305,8 @@ def cmd_run(args) -> int:
     obj = client.apply(doc)
     kind, name = obj["kind"], obj["metadata"]["name"]
     ns = obj["metadata"]["namespace"]
-    print(f"{kind.lower()}/{name} applied (upload {size} bytes, md5 {md5})")
+    if progress is None:
+        print(f"{kind.lower()}/{name} applied (upload {size} bytes, md5 {md5})")
 
     # Wait for our signed URL (reference upload.go:126-178).
     url = None
@@ -276,7 +318,7 @@ def cmd_run(args) -> int:
         if bu.get("requestId") == request_id and bu.get("signedUrl"):
             url = bu["signedUrl"]
             break
-        time.sleep(0.1 if args.fake else 2)
+        time.sleep(0.05 if args.fake else 2)
     if url is None:
         raise SystemExit("controller never published a signed upload URL")
 
@@ -284,11 +326,17 @@ def cmd_run(args) -> int:
         if args.fake and _FAKE_ENV is not None:
             with open(tar_path, "rb") as f:
                 _FAKE_ENV.accept_upload(f.read(), md5)
-            print("uploaded to fake storage")
+            if progress is not None:
+                progress(size, size)
+            else:
+                print("uploaded to fake storage")
         else:
             with open(tar_path, "rb") as f:
+                data = f if progress is None else _ProgressReader(
+                    f, size, progress
+                )
                 req = urllib.request.Request(
-                    url, data=f, method="PUT",
+                    url, data=data, method="PUT",
                     headers={
                         "Content-Type": "application/octet-stream",
                         # Signed URLs are md5-bound; storage rejects a PUT
@@ -300,7 +348,8 @@ def cmd_run(args) -> int:
                 )
                 with urllib.request.urlopen(req, timeout=300) as r:
                     r.read()
-            print(f"uploaded ({r.status})")
+            if progress is None:
+                print(f"uploaded ({r.status})")
             # nudge the controller (reference upload.go:184-189)
             live = client.get(kind, ns, name)
             live["metadata"].setdefault("annotations", {})[
@@ -309,8 +358,28 @@ def cmd_run(args) -> int:
             client.update(live)
     finally:
         os.unlink(tar_path)
+    return obj
 
-    _wait_ready(client, kind, ns, name, fake=args.fake)
+
+def cmd_run(args) -> int:
+    """Upload the current dir and run it as a Dataset or Model (reference
+    internal/cli/run.go:16-104). On a real terminal this is the interactive
+    TUI flow (cli/flows.py); --plain (or a non-tty) selects line output."""
+    from substratus_tpu.cli import tui
+
+    if tui.interactive() and not getattr(args, "plain", False):
+        from substratus_tpu.cli.flows import run_flow
+
+        return run_flow(args)
+    client = _client(args)
+    docs = _load_manifests(args.filename) if args.filename else []
+    if not docs:
+        raise SystemExit("run requires -f manifest describing the Dataset/Model")
+    obj = upload_context(args, client, docs[0])
+    _wait_ready(
+        client, obj["kind"], obj["metadata"]["namespace"],
+        obj["metadata"]["name"], fake=args.fake,
+    )
     return 0
 
 
@@ -328,6 +397,12 @@ def cmd_serve(args) -> int:
 
 
 def cmd_notebook(args) -> int:
+    from substratus_tpu.cli import tui
+
+    if tui.interactive() and not getattr(args, "plain", False):
+        from substratus_tpu.cli.flows import notebook_flow
+
+        return notebook_flow(args)
     from substratus_tpu.cli.notebook import run_notebook
 
     return run_notebook(args, _client(args))
@@ -344,22 +419,17 @@ def cmd_logs(args) -> int:
     obj = client.get_or_none(kind, args.namespace, args.name)
     if obj is None:
         raise SystemExit(f"{kind.lower()}/{args.name} not found")
-    suffix = {
-        "Dataset": "-data-loader",
-        "Model": "-modeller",
-        "Notebook": "-notebook",
-        "Server": "-server",
-    }[kind]
-    workload = f"{args.name}{suffix}"
     if args.fake:
-        for wkind in ("Job", "JobSet", "Deployment", "Pod"):
-            w = client.get_or_none(wkind, args.namespace, workload)
-            if w is not None:
-                print(f"{wkind.lower()}/{workload} (fake cluster; no kubelet logs)")
-                print(json.dumps(w.get("status", {}), indent=2))
-                return 0
-        print(f"no workload found for {kind.lower()}/{args.name}")
-        return 1
+        lines = fake_workload_status_lines(
+            client, args.namespace, kind, args.name
+        )
+        if lines is None:
+            print(f"no workload found for {kind.lower()}/{args.name}")
+            return 1
+        print(f"{lines[0]} (fake cluster; no kubelet logs)")
+        for line in lines[1:]:
+            print(line)
+        return 0
     import shutil
     import subprocess
 
@@ -390,6 +460,10 @@ def register(sub) -> None:
         p.add_argument(
             "--fake", action="store_true",
             help="in-process fake cluster (local dev)",
+        )
+        p.add_argument(
+            "--plain", action="store_true",
+            help="line output instead of the interactive TUI",
         )
 
     p = sub.add_parser("apply", help="apply substratus manifests")

@@ -1,0 +1,200 @@
+"""Interactive `sub` flows: TUI compositions of the run/notebook pipelines
+(reference: internal/tui/run.go:15, internal/tui/notebook.go:65-91 —
+manifest picker → upload progress → readiness → pods/logs → sync +
+port-forward → browser).
+
+Each flow builds a tui.Sequence of stage models over the same primitives
+the plain CLI path uses (commands._tarball, the kube client, the fake env),
+so `--fake` drives the full composition against the in-process cluster.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+from substratus_tpu.cli import tui
+
+
+def _fake_env():
+    from substratus_tpu.cli import commands
+
+    return commands._FAKE_ENV
+
+
+def _manifest_label(doc: dict) -> str:
+    return f"{doc['kind'].lower()}/{doc['metadata'].get('name', '?')}"
+
+
+_KIND_PREFERENCE = ("Notebook", "Model", "Dataset", "Server")
+
+
+def _pick_manifests(args, prefer_kinds=_KIND_PREFERENCE):
+    """Stage 0: scan + order candidate manifests (reference
+    manifestsModel's kind preference, tui/notebook.go:66-71)."""
+    from substratus_tpu.cli.commands import _load_manifests
+
+    docs = _load_manifests(args.filename)
+    docs.sort(
+        key=lambda d: (
+            prefer_kinds.index(d["kind"])
+            if d["kind"] in prefer_kinds
+            else len(prefer_kinds)
+        )
+    )
+    return docs
+
+
+def _upload_stage(args, client, doc) -> tui.Progress:
+    """Tar + signed-URL PUT with a live bar (reference uploadModel,
+    tui/upload.go:92-140); the protocol lives in commands.upload_context."""
+    from substratus_tpu.cli.commands import upload_context
+
+    return tui.Progress(
+        "upload build context",
+        lambda progress: upload_context(args, client, doc, progress=progress),
+    )
+
+
+def _readiness_stage(args, client, obj) -> tui.Spinner:
+    from substratus_tpu.cli.commands import _wait_ready
+
+    kind, name = obj["kind"], obj["metadata"]["name"]
+    ns = obj["metadata"]["namespace"]
+    return tui.Spinner(
+        f"waiting for {kind.lower()}/{name}",
+        lambda set_status: _wait_ready(
+            client, kind, ns, name, fake=args.fake, on_status=set_status
+        ),
+    )
+
+
+def _logs_stage(args, client, obj) -> Optional[tui.LogView]:
+    """Workload status/log tail (reference podsModel). Fake cluster: the
+    workload object's status; real cluster: kubectl log tail."""
+    from substratus_tpu.cli.commands import (
+        WORKLOAD_SUFFIX,
+        fake_workload_status_lines,
+    )
+
+    kind, name = obj["kind"], obj["metadata"]["name"]
+    ns = obj["metadata"]["namespace"]
+    workload = f"{name}{WORKLOAD_SUFFIX[kind]}"
+
+    def work(log: Callable[[str], None]) -> Any:
+        if args.fake:
+            for line in fake_workload_status_lines(
+                client, ns, kind, name
+            ) or [f"no workload found for {kind.lower()}/{name}"]:
+                log(line)
+            return obj
+        import shutil
+        import subprocess
+
+        kubectl = shutil.which("kubectl")
+        if kubectl is None:
+            log("kubectl not on PATH; skipping logs")
+            return obj
+        sel = f"substratus.ai/object={kind.lower()}-{name}"
+        proc = subprocess.Popen(
+            [kubectl, "-n", ns, "logs", "-l", sel, "--tail", "20"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        for line in proc.stdout:
+            log(line.rstrip())
+        return obj
+
+    return tui.LogView(f"{workload} status", work)
+
+
+def run_flow(args) -> int:
+    """`sub run` interactively: pick → upload → readiness → logs."""
+    from substratus_tpu.cli.commands import _client
+
+    client = _client(args)
+    docs = _pick_manifests(args, prefer_kinds=("Model", "Dataset"))
+    seq = tui.Sequence([
+        lambda _: tui.Picker("run which manifest?", docs, _manifest_label),
+        lambda doc: _upload_stage(args, client, doc),
+        lambda obj: _readiness_stage(args, client, obj),
+        lambda obj: _logs_stage(args, client, obj),
+    ])
+    tui.Runtime().run(seq)
+    return 0
+
+
+def notebook_flow(args) -> int:
+    """`sub notebook` interactively: pick → convert → readiness → sync +
+    port-forward → browser (reference tui/notebook.go:65-91)."""
+    from substratus_tpu.cli.commands import _client
+    from substratus_tpu.cli.notebook import notebook_for_object
+
+    client = _client(args)
+    docs = _pick_manifests(args)
+
+    def to_notebook(doc):
+        nb = doc if doc["kind"] == "Notebook" else notebook_for_object(doc)
+        nb.setdefault("metadata", {}).setdefault("namespace", args.namespace)
+        nb.setdefault("spec", {})["suspend"] = False
+        return client.apply(nb)
+
+    def devloop_stage(obj):
+        if args.fake:
+            return None  # no kubelet to forward to
+        name = obj["metadata"]["name"]
+        ns = obj["metadata"]["namespace"]
+        pod = f"{name}-notebook"
+
+        def work(log: Callable[[str], None]) -> Any:
+            import socket
+            import threading
+            import webbrowser
+
+            from substratus_tpu.cli.sync import (
+                port_forward,
+                sync_files_from_notebook,
+            )
+
+            stop = threading.Event()
+            threading.Thread(
+                target=sync_files_from_notebook,
+                args=(ns, pod, os.getcwd()),
+                kwargs={
+                    "stop": stop,
+                    "on_event": lambda e: log(f"sync: {e['op']} {e['path']}"),
+                },
+                daemon=True,
+            ).start()
+            fwd = threading.Thread(
+                target=port_forward, args=(ns, pod, 8888, 8888),
+                kwargs={"stop": stop}, daemon=True,
+            )
+            fwd.start()
+            url = "http://localhost:8888?token=default"
+            for _ in range(60):
+                try:
+                    with socket.create_connection(
+                        ("localhost", 8888), timeout=0.5
+                    ):
+                        break
+                except OSError:
+                    time.sleep(0.5)
+            log(f"forwarding :8888 — {url} (ctrl-c to stop)")
+            if not args.no_open:
+                webbrowser.open(url)
+            while fwd.is_alive():
+                fwd.join(timeout=1.0)
+            return obj
+
+        return tui.LogView("notebook dev loop", work, height=12)
+
+    seq = tui.Sequence([
+        lambda _: tui.Picker("open which manifest?", docs, _manifest_label),
+        lambda doc: tui.Spinner(
+            "applying notebook", lambda set_status: to_notebook(doc)
+        ),
+        lambda obj: _readiness_stage(args, client, obj),
+        devloop_stage,
+    ])
+    tui.Runtime().run(seq)
+    return 0

@@ -55,8 +55,8 @@ def run_notebook(args, client) -> int:
     doc = next((d for d in docs if d["kind"] == "Notebook"), None)
     if doc is None:
         doc = notebook_for_object(docs[0])
-    doc["metadata"].setdefault("namespace", args.namespace)
-    doc["spec"]["suspend"] = False
+    doc.setdefault("metadata", {}).setdefault("namespace", args.namespace)
+    doc.setdefault("spec", {})["suspend"] = False
     obj = client.apply(doc)
     name = obj["metadata"]["name"]
     ns = obj["metadata"]["namespace"]

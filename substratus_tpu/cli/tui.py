@@ -1,0 +1,444 @@
+"""Composable interactive terminal UI for `sub` (reference: the bubbletea
+TUI in internal/tui — NotebookModel/RunModel composed from manifestsModel,
+uploadModel, readinessModel, podsModel; internal/tui/notebook.go:65-91).
+
+Dependency-free ANSI implementation of the same architecture:
+
+  * a Model has update(msg) -> messages-consumed state machine and a
+    view() -> str render; the runtime owns the terminal (cbreak mode,
+    alternate-screen-free incremental redraw) and the message queue;
+  * messages: KeyMsg (keyboard), TickMsg (timer), or any object a
+    background command posts; commands run in daemon threads via
+    ctx.spawn(fn) and their return values (or raised exceptions) are
+    posted back as messages — update() never blocks;
+  * Sequence composes stage models: each stage's `result` feeds the next
+    stage's factory, mirroring the reference's flow composition.
+
+When stdout is not a TTY every flow falls back to the plain line-printing
+path (the pre-TUI behavior), so scripts and CI logs stay sane.
+"""
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+SPINNER = "⠋⠙⠹⠸⠼⠴⠦⠧⠇⠏"
+
+
+@dataclass
+class KeyMsg:
+    key: str  # "up", "down", "enter", "q", single chars, ...
+
+
+@dataclass
+class TickMsg:
+    t: float
+
+
+@dataclass
+class ErrMsg:
+    error: BaseException
+
+
+@dataclass
+class DoneMsg:
+    result: Any = None
+
+
+class Quit(Exception):
+    """Raised (or posted) to stop the runtime; .result carries the value."""
+
+    def __init__(self, result: Any = None):
+        self.result = result
+
+
+class Context:
+    """Runtime handle given to models: post messages, spawn commands."""
+
+    def __init__(self):
+        self.queue: "queue.Queue[Any]" = queue.Queue()
+
+    def post(self, msg: Any) -> None:
+        self.queue.put(msg)
+
+    def spawn(self, fn: Callable[[], Any]) -> None:
+        """Run fn on a daemon thread; post its return value (or ErrMsg)."""
+
+        def run():
+            try:
+                out = fn()
+                if out is not None:
+                    self.post(out)
+            except BaseException as e:  # surfaced to update(), not lost
+                self.post(ErrMsg(e))
+
+        threading.Thread(target=run, daemon=True).start()
+
+
+class Model:
+    """Base stage: subclasses set self.done=True (+ self.result) or raise
+    Quit to abort the whole program."""
+
+    done = False
+    result: Any = None
+    failed: Optional[str] = None
+
+    def start(self, ctx: Context) -> None:  # begin async work
+        pass
+
+    def update(self, ctx: Context, msg: Any) -> None:
+        pass
+
+    def view(self) -> str:
+        return ""
+
+
+_KEYMAP = {
+    "\x1b[A": "up", "\x1b[B": "down", "\x1b[C": "right", "\x1b[D": "left",
+    "\r": "enter", "\n": "enter", "\x7f": "backspace", "\x1b": "esc",
+    "\x03": "ctrl-c",
+}
+
+
+def _read_keys(stdin, ctx: Context, stop: threading.Event) -> None:
+    """Raw key reader thread. Bytes are fed through an incremental UTF-8
+    decoder (a split multi-byte keypress must never read as EOF), and a
+    lone Esc is disambiguated from an escape sequence by a short timeout —
+    only an empty os.read (true EOF) ends the thread."""
+    import codecs
+    import os
+    import select as _select
+
+    fd = stdin.fileno()
+    dec = codecs.getincrementaldecoder("utf-8")("ignore")
+    pending = ""  # chars accumulating a possible escape sequence
+
+    def flush_pending():
+        nonlocal pending
+        if pending == "\x1b":
+            ctx.post(KeyMsg("esc"))
+        elif pending:  # truncated sequence: best-effort last char
+            ctx.post(KeyMsg(pending[-1]))
+        pending = ""
+
+    while not stop.is_set():
+        try:
+            ready, _, _ = _select.select([fd], [], [], 0.05)
+        except OSError:
+            return
+        if not ready:
+            if pending:
+                flush_pending()
+            continue
+        try:
+            data = os.read(fd, 64)
+        except OSError:
+            return
+        if not data:
+            return
+        for ch in dec.decode(data):
+            pending += ch
+            if pending == "\x1b":
+                continue  # maybe an escape sequence; wait for more
+            if pending.startswith("\x1b") and len(pending) < 3:
+                continue
+            key = _KEYMAP.get(
+                pending, pending if len(pending) == 1 else pending[-1]
+            )
+            pending = ""
+            ctx.post(KeyMsg(key))
+
+
+class Runtime:
+    """Owns the terminal; runs one (possibly composed) model to completion.
+
+    Rendering is incremental: move home + erase-to-end per frame, no
+    alternate screen — the final frame stays in the scrollback, which is
+    what operators want from one-shot flows like `sub run`.
+    """
+
+    def __init__(self, stdin=None, stdout=None, fps: float = 15.0):
+        self.stdin = stdin or sys.stdin
+        self.stdout = stdout or sys.stdout
+        self.fps = fps
+
+    def run(self, model: Model) -> Any:
+        import termios
+        import tty
+
+        ctx = Context()
+        stop = threading.Event()
+        fd = self.stdin.fileno()
+        old = termios.tcgetattr(fd)
+        tty.setcbreak(fd)
+        reader = threading.Thread(
+            target=_read_keys, args=(self.stdin, ctx, stop), daemon=True
+        )
+        reader.start()
+
+        def ticker():
+            while not stop.is_set():
+                ctx.post(TickMsg(time.time()))
+                time.sleep(1.0 / self.fps)
+
+        threading.Thread(target=ticker, daemon=True).start()
+
+        last_lines = 0
+        self.stdout.write("\x1b[?25l")  # hide cursor
+        try:
+            model.start(ctx)
+            while True:
+                frame = model.view()
+                self._paint(frame, last_lines)
+                last_lines = frame.count("\n") + 1
+                # cbreak keeps ISIG: Ctrl-C arrives as KeyboardInterrupt in
+                # this blocked get(), not as a '\x03' byte — treat it as a
+                # clean quit, never a traceback.
+                try:
+                    msg = ctx.queue.get()
+                except KeyboardInterrupt:
+                    raise Quit(None)
+                if isinstance(msg, KeyMsg) and msg.key == "ctrl-c":
+                    raise Quit(None)
+                model.update(ctx, msg)
+                if model.failed is not None:
+                    raise Quit(SystemExit(model.failed))
+                if model.done:
+                    self._paint(model.view(), last_lines, final=True)
+                    return model.result
+        except Quit as q:
+            self._paint(model.view(), last_lines, final=True)
+            if isinstance(q.result, BaseException):
+                raise q.result
+            return q.result
+        finally:
+            stop.set()
+            self.stdout.write("\x1b[?25h")  # show cursor
+            self.stdout.flush()
+            termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    def _paint(self, frame: str, last_lines: int, final: bool = False) -> None:
+        # Move up over the previous frame, erase below, draw.
+        out = ""
+        if last_lines:
+            out += f"\x1b[{last_lines - 1}F" if last_lines > 1 else "\r"
+        out += "\x1b[J" + frame
+        if final:
+            out += "\n"
+        self.stdout.write(out)
+        self.stdout.flush()
+
+
+class Sequence(Model):
+    """Run stages one after another; each factory receives the previous
+    stage's result (the reference composes NotebookModel the same way)."""
+
+    def __init__(self, factories: List[Callable[[Any], Optional[Model]]]):
+        self.factories = list(factories)
+        self.current: Optional[Model] = None
+        self.history: List[str] = []
+        self._ctx: Optional[Context] = None
+        self._last_result: Any = None
+
+    def start(self, ctx: Context) -> None:
+        self._ctx = ctx
+        self._advance(None)
+
+    def _advance(self, result: Any) -> None:
+        self._last_result = result
+        while self.factories:
+            factory = self.factories.pop(0)
+            nxt = factory(result)
+            if nxt is None:  # stage skipped for this flow
+                continue
+            self.current = nxt
+            nxt.start(self._ctx)
+            if nxt.failed is not None:
+                self.failed = nxt.failed
+                return
+            if nxt.done:  # completed synchronously (e.g. one-item picker)
+                final = nxt.view().rstrip("\n")
+                if final:
+                    self.history.append(final)
+                result = nxt.result
+                continue
+            return
+        self.current = None
+        self.done, self.result = True, result
+
+    def update(self, ctx: Context, msg: Any) -> None:
+        if self.current is None:
+            return
+        self.current.update(ctx, msg)
+        if self.current.failed is not None:
+            self.failed = self.current.failed
+            return
+        if self.current.done:
+            final = self.current.view().rstrip("\n")
+            if final:
+                self.history.append(final)
+            self._advance(self.current.result)
+
+    def view(self) -> str:
+        parts = list(self.history)
+        if self.current is not None:
+            parts.append(self.current.view().rstrip("\n"))
+        return "\n".join(parts) if parts else ""
+
+
+# --- reusable stage models -------------------------------------------------
+
+
+class Picker(Model):
+    """Choose one item with arrows+enter; auto-picks a single candidate.
+    (reference: manifestsModel — scan dir, prefer kinds, pick)."""
+
+    def __init__(self, title: str, items: List[Any],
+                 label: Callable[[Any], str] = str):
+        if not items:
+            raise SystemExit(f"{title}: nothing to choose from")
+        self.title = title
+        self.items = items
+        self.label = label
+        self.idx = 0
+        if len(items) == 1:
+            self.done, self.result = True, items[0]
+
+    def update(self, ctx: Context, msg: Any) -> None:
+        if not isinstance(msg, KeyMsg):
+            return
+        if msg.key in ("up", "k"):
+            self.idx = (self.idx - 1) % len(self.items)
+        elif msg.key in ("down", "j", "\t"):
+            self.idx = (self.idx + 1) % len(self.items)
+        elif msg.key == "enter":
+            self.done, self.result = True, self.items[self.idx]
+        elif msg.key in ("q", "esc"):
+            raise Quit(None)
+
+    def view(self) -> str:
+        if self.done:
+            return f"✓ {self.title}: {self.label(self.result)}"
+        lines = [f"? {self.title} (↑/↓ + enter):"]
+        for i, it in enumerate(self.items):
+            cursor = "➤" if i == self.idx else " "
+            lines.append(f"  {cursor} {self.label(it)}")
+        return "\n".join(lines)
+
+
+class Spinner(Model):
+    """Run one background function with a spinner + live status line.
+    fn(set_status) -> result. (reference: readinessModel)."""
+
+    def __init__(self, title: str, fn: Callable[[Callable[[str], None]], Any]):
+        self.title = title
+        self.fn = fn
+        self.status = ""
+        self.frame = 0
+
+    def start(self, ctx: Context) -> None:
+        def run():
+            out = self.fn(lambda s: ctx.post(("status", s)))
+            return DoneMsg(out)
+
+        ctx.spawn(run)
+
+    def update(self, ctx: Context, msg: Any) -> None:
+        if isinstance(msg, TickMsg):
+            self.frame += 1
+        elif isinstance(msg, tuple) and msg and msg[0] == "status":
+            self.status = msg[1]
+        elif isinstance(msg, DoneMsg):
+            self.done, self.result = True, msg.result
+        elif isinstance(msg, ErrMsg):
+            self.failed = str(msg.error)
+
+    def view(self) -> str:
+        if self.done:
+            return f"✓ {self.title}" + (f" — {self.status}" if self.status else "")
+        spin = SPINNER[self.frame % len(SPINNER)]
+        tail = f" — {self.status}" if self.status else ""
+        return f"{spin} {self.title}{tail}"
+
+
+class Progress(Model):
+    """Byte progress bar; the worker posts ("progress", done, total) and a
+    final DoneMsg. (reference: uploadModel, upload.go:92-140)."""
+
+    def __init__(self, title: str,
+                 fn: Callable[[Callable[[int, int], None]], Any]):
+        self.title = title
+        self.fn = fn
+        self.sent = 0
+        self.total = 0
+
+    def start(self, ctx: Context) -> None:
+        def run():
+            out = self.fn(
+                lambda done, total: ctx.post(("progress", done, total))
+            )
+            return DoneMsg(out)
+
+        ctx.spawn(run)
+
+    def update(self, ctx: Context, msg: Any) -> None:
+        if isinstance(msg, tuple) and msg and msg[0] == "progress":
+            _, self.sent, self.total = msg
+        elif isinstance(msg, DoneMsg):
+            self.done, self.result = True, msg.result
+        elif isinstance(msg, ErrMsg):
+            self.failed = str(msg.error)
+
+    def view(self) -> str:
+        width = 28
+        if self.total:
+            frac = min(1.0, self.sent / self.total)
+            fill = int(frac * width)
+            bar = "█" * fill + "░" * (width - fill)
+            pct = f"{frac * 100:3.0f}%"
+        else:
+            bar, pct = "░" * width, "  …"
+        mark = "✓" if self.done else "⇡"
+        return f"{mark} {self.title} [{bar}] {pct}"
+
+
+class LogView(Model):
+    """Scrolling tail of lines posted as ("log", line); finishes on
+    DoneMsg. (reference: podsModel log pane)."""
+
+    def __init__(self, title: str, fn: Callable[[Callable[[str], None]], Any],
+                 height: int = 8):
+        self.title = title
+        self.fn = fn
+        self.lines: List[str] = []
+        self.height = height
+
+    def start(self, ctx: Context) -> None:
+        def run():
+            out = self.fn(lambda line: ctx.post(("log", line)))
+            return DoneMsg(out)
+
+        ctx.spawn(run)
+
+    def update(self, ctx: Context, msg: Any) -> None:
+        if isinstance(msg, tuple) and msg and msg[0] == "log":
+            self.lines.append(msg[1])
+        elif isinstance(msg, DoneMsg):
+            self.done, self.result = True, msg.result
+        elif isinstance(msg, ErrMsg):
+            self.failed = str(msg.error)
+
+    def view(self) -> str:
+        head = f"{'✓' if self.done else '┃'} {self.title}"
+        tail = self.lines[-self.height:]
+        return "\n".join([head] + [f"  │ {ln}" for ln in tail])
+
+
+def interactive(stdout=None) -> bool:
+    """TUI flows only when attached to a real terminal."""
+    out = stdout or sys.stdout
+    return hasattr(out, "isatty") and out.isatty() and sys.stdin.isatty()

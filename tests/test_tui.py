@@ -1,0 +1,209 @@
+"""Interactive TUI tests: drive `sub run` / `sub notebook` through a real
+pty against the fake cluster (reference analogue: the bubbletea flows in
+internal/tui composed per internal/tui/notebook.go:65-91), plus unit tests
+of the stage models with a scripted message feed.
+"""
+import os
+import pty
+import select
+import subprocess
+import sys
+import time
+
+import pytest
+
+from substratus_tpu.cli import tui
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _drive_pty(argv, keys=b"", timeout=120.0, env_extra=None):
+    """Spawn `python -m substratus_tpu.cli.main <argv>` on a pty, send
+    keys, collect output until exit. Returns (output, returncode)."""
+    master, slave = pty.openpty()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "substratus_tpu.cli.main"] + argv,
+        stdin=slave, stdout=slave, stderr=slave, env=env, close_fds=True,
+    )
+    os.close(slave)
+    out = b""
+    sent = False
+    t0 = time.time()
+    try:
+        while time.time() - t0 < timeout:
+            r, _, _ = select.select([master], [], [], 0.2)
+            if r:
+                try:
+                    chunk = os.read(master, 65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                out += chunk
+                if keys and not sent and b"?" in out:
+                    # The picker prompt is up: play the scripted keys.
+                    os.write(master, keys)
+                    sent = True
+            if proc.poll() is not None:
+                # Drain whatever remains.
+                while True:
+                    r, _, _ = select.select([master], [], [], 0.2)
+                    if not r:
+                        break
+                    try:
+                        chunk = os.read(master, 65536)
+                    except OSError:
+                        break
+                    if not chunk:
+                        break
+                    out += chunk
+                break
+        else:
+            proc.kill()
+            pytest.fail(f"pty flow timed out; output:\n{out.decode(errors='replace')}")
+    finally:
+        os.close(master)
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+    return out.decode(errors="replace"), proc.returncode
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    (tmp_path / "Dockerfile").write_text("FROM scratch\n")
+    (tmp_path / "train.py").write_text("print('hi')\n")
+    (tmp_path / "model.yaml").write_text(
+        """
+apiVersion: substratus.ai/v1
+kind: Model
+metadata:
+  name: tui-model
+spec:
+  image: registry.local/tui-model
+  command: ["python", "train.py"]
+""".lstrip()
+    )
+    (tmp_path / "dataset.yaml").write_text(
+        """
+apiVersion: substratus.ai/v1
+kind: Dataset
+metadata:
+  name: tui-data
+spec:
+  image: registry.local/tui-data
+  command: ["python", "load.py"]
+""".lstrip()
+    )
+    return tmp_path
+
+
+def test_pty_run_flow_full_composition(workdir):
+    """`sub run` on a pty: picker (two manifests -> needs a keypress),
+    upload progress bar, readiness spinner, workload logs — end to end
+    against the fake cluster."""
+    out, rc = _drive_pty(
+        [
+            "run", "-f", str(workdir), "-d", str(workdir), "--fake",
+        ],
+        keys=b"\r",  # accept the highlighted (Model-first) manifest
+    )
+    assert rc == 0, out
+    assert "run which manifest?" in out
+    assert "model/tui-model" in out
+    assert "upload build context" in out and "100%" in out
+    assert "waiting for model/tui-model" in out
+    assert "✓" in out
+    assert "tui-model-modeller" in out  # logs stage reached
+
+
+def test_pty_notebook_flow(workdir):
+    """`sub notebook` on a pty: picker -> conversion -> readiness (fake
+    cluster stops before port-forward, like the plain path)."""
+    out, rc = _drive_pty(
+        ["notebook", "-f", str(workdir), "--fake", "--no-open"],
+        keys=b"\r",
+    )
+    assert rc == 0, out
+    assert "open which manifest?" in out
+    assert "applying notebook" in out
+    assert "waiting for notebook/tui-model" in out
+    assert "✓" in out
+
+
+def test_pty_plain_flag_skips_tui(workdir):
+    """--plain on a tty keeps the line-printing path (no picker UI)."""
+    out, rc = _drive_pty(
+        ["run", "-f", str(workdir / "model.yaml"), "-d", str(workdir),
+         "--fake", "--plain"],
+    )
+    assert rc == 0, out
+    assert "run which manifest?" not in out
+    assert "applied" in out and "ready" in out
+
+
+# --- stage-model unit tests (no pty) --------------------------------------
+
+
+def test_picker_navigation_and_selection():
+    ctx = tui.Context()
+    p = tui.Picker("pick", ["a", "b", "c"])
+    p.update(ctx, tui.KeyMsg("down"))
+    p.update(ctx, tui.KeyMsg("down"))
+    p.update(ctx, tui.KeyMsg("up"))
+    assert "➤ b" in p.view()
+    p.update(ctx, tui.KeyMsg("enter"))
+    assert p.done and p.result == "b"
+
+
+def test_picker_autoselects_single_item():
+    p = tui.Picker("pick", ["only"])
+    assert p.done and p.result == "only"
+
+
+def test_sequence_threads_results_and_skips_none():
+    ctx = tui.Context()
+
+    class Instant(tui.Model):
+        def __init__(self, result):
+            self._r = result
+
+        def start(self, ctx):
+            self.done, self.result = True, self._r
+
+    seq = tui.Sequence([
+        lambda _: tui.Picker("pick", [1]),
+        lambda prev: Instant(prev + 1),
+        lambda prev: None,  # skipped stage
+        lambda prev: Instant(prev * 10),
+    ])
+    seq.start(ctx)
+    # Drive: picker auto-done needs one update cycle to advance.
+    seq.update(ctx, tui.TickMsg(0.0))
+    assert seq.done and seq.result == 20
+
+
+def test_spinner_surfaces_worker_errors():
+    ctx = tui.Context()
+
+    def boom(_):
+        raise RuntimeError("nope")
+
+    s = tui.Spinner("work", boom)
+    s.start(ctx)
+    msg = ctx.queue.get(timeout=10)
+    s.update(ctx, msg)
+    assert s.failed == "nope"
+
+
+def test_progress_renders_bar():
+    ctx = tui.Context()
+    pr = tui.Progress("up", lambda cb: cb(50, 100))
+    pr.update(ctx, ("progress", 50, 100))
+    v = pr.view()
+    assert "50%" in v and "█" in v and "░" in v

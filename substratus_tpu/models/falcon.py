@@ -137,14 +137,16 @@ def init_params(cfg: FalconConfig, key: jax.Array) -> Params:
 def init_cache(
     cfg: FalconConfig, batch: int, max_len: Optional[int] = None, dtype=None
 ) -> Params:
+    """Decode KV cache [L, B, KH, S, head_dim] — per-head sequence-
+    contiguous, same convention as llama.init_cache (KH=1 for MQA)."""
     S = max_len or cfg.max_seq_len
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_size)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, S, cfg.head_size)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def cache_logical_axes(cfg: FalconConfig, quantized: bool = False) -> Params:
-    ax = ("layers", "cache_batch", "cache_seq", "kv_heads", "head_dim")
+    ax = ("layers", "cache_batch", "kv_heads", "cache_seq", "head_dim")
     return {"k": ax, "v": ax}
 
 
@@ -174,18 +176,11 @@ def _block(x, lp, positions, cfg, layer_cache, kv_length=None,
         attn = dot_product_attention(q, kk, vv, causal=True, q_positions=positions)
         kv_out = {"k": kk, "v": vv}
     else:
-        rows = jnp.arange(x.shape[0])[:, None]
-        k_cache = layer_cache["k"].at[rows, positions].set(
-            kk.astype(layer_cache["k"].dtype)
+        from substratus_tpu.ops.decode_attention import update_cache_and_attend
+
+        attn, kv_out = update_cache_and_attend(
+            layer_cache, q, kk, vv, positions, kv_length=kv_length,
         )
-        v_cache = layer_cache["v"].at[rows, positions].set(
-            vv.astype(layer_cache["v"].dtype)
-        )
-        attn = dot_product_attention(
-            q, k_cache, v_cache, causal=True, q_positions=positions,
-            kv_length=kv_length,
-        )
-        kv_out = {"k": k_cache, "v": v_cache}
 
     attn_out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
     if "wo" in lora:

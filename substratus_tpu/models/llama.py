@@ -59,6 +59,10 @@ class LlamaConfig:
     #             requires an ambient mesh (jax.sharding.use_mesh) with a
     #             "sequence" axis
     attn_impl: str = "xla"
+    # Decode-with-cache attention implementation (ops/decode_attention.py):
+    #   "xla"    — scale-after-dot einsums (default; also fastest measured)
+    #   "pallas" — fused int8-dequant flash-decode Mosaic kernel
+    decode_attn_impl: str = "xla"
     # Mixture-of-experts (Mixtral family): n_experts == 0 means dense MLP.
     # Routed top-k with GShard-style capacity dispatch; expert weights shard
     # over the "expert" mesh axis (expert parallelism).
@@ -216,29 +220,34 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
 def init_cache(
     cfg: LlamaConfig, batch: int, max_len: Optional[int] = None, dtype=None
 ) -> Params:
-    """Decode KV cache, layers-stacked: k/v [L, B, S, KH, head_dim].
+    """Decode KV cache, layers-stacked: k/v [L, B, KH, S, head_dim].
+
+    The per-head sequence-contiguous layout (KH before S) makes each kv
+    head's history one contiguous HBM stream for the decode-attention
+    read (ops/decode_attention.py) — the [B, S, KH, D] activation layout
+    would interleave heads every D elements.
 
     dtype=jnp.int8 stores entries quantized per-vector (ops/quant.py
-    quantize_kv) with f32 scales alongside — decode is bandwidth-bound on
-    the cache read, so int8 roughly halves its HBM traffic.
+    quantize_kv) with f32 scales alongside ([L, B, KH, S]) — decode is
+    bandwidth-bound on the cache read, so int8 roughly halves its HBM
+    traffic.
     """
     S = max_len or cfg.max_seq_len
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_size)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, S, cfg.head_size)
     cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
     if dtype == jnp.int8:
-        sshape = shape[:-1] + (1,)
-        cache["k_scale"] = jnp.ones(sshape, jnp.float32)
-        cache["v_scale"] = jnp.ones(sshape, jnp.float32)
+        cache["k_scale"] = jnp.ones(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.ones(shape[:-1], jnp.float32)
     return cache
 
 
 def cache_logical_axes(cfg: LlamaConfig, quantized: bool = False) -> Params:
-    ax = ("layers", "cache_batch", "cache_seq", "kv_heads", "head_dim")
+    ax = ("layers", "cache_batch", "kv_heads", "cache_seq", "head_dim")
     axes = {"k": ax, "v": ax}
     if quantized:
-        axes["k_scale"] = ax
-        axes["v_scale"] = ax
+        axes["k_scale"] = ax[:-1]
+        axes["v_scale"] = ax[:-1]
     return axes
 
 
@@ -449,38 +458,11 @@ def _block(
             kv_length=kv_length,
         )
     else:
-        from substratus_tpu.ops.quant import dequantize_kv, quantize_kv
+        from substratus_tpu.ops.decode_attention import update_cache_and_attend
 
-        b = x.shape[0]
-        rows = jnp.arange(b)[:, None]
-        quantized = "k_scale" in layer_cache
-        kv_out = {}
-        if quantized:
-            kq, kscale = quantize_kv(kk)
-            vq, vscale = quantize_kv(vv)
-            kv_out["k"] = layer_cache["k"].at[rows, positions].set(kq)
-            kv_out["v"] = layer_cache["v"].at[rows, positions].set(vq)
-            kv_out["k_scale"] = (
-                layer_cache["k_scale"].at[rows, positions].set(kscale)
-            )
-            kv_out["v_scale"] = (
-                layer_cache["v_scale"].at[rows, positions].set(vscale)
-            )
-            k_cache = dequantize_kv(kv_out["k"], kv_out["k_scale"], dt)
-            v_cache = dequantize_kv(kv_out["v"], kv_out["v_scale"], dt)
-        else:
-            kv_out["k"] = (
-                layer_cache["k"].at[rows, positions]
-                .set(kk.astype(layer_cache["k"].dtype))
-            )
-            kv_out["v"] = (
-                layer_cache["v"].at[rows, positions]
-                .set(vv.astype(layer_cache["v"].dtype))
-            )
-            k_cache, v_cache = kv_out["k"], kv_out["v"]
-        attn = dot_product_attention(
-            q, k_cache, v_cache, causal=True, q_positions=positions,
-            kv_length=kv_length,
+        attn, kv_out = update_cache_and_attend(
+            layer_cache, q, kk, vv, positions,
+            kv_length=kv_length, impl=cfg.decode_attn_impl,
         )
 
     b, s = x.shape[:2]

@@ -136,14 +136,16 @@ def init_params(cfg: OPTConfig, key: jax.Array) -> Params:
 def init_cache(
     cfg: OPTConfig, batch: int, max_len: Optional[int] = None, dtype=None
 ) -> Params:
+    """Decode KV cache [L, B, KH, S, head_dim] — per-head sequence-
+    contiguous, same convention as llama.init_cache."""
     S = max_len or cfg.max_seq_len
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, S, cfg.n_heads, cfg.head_size)
+    shape = (cfg.n_layers, batch, cfg.n_heads, S, cfg.head_size)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def cache_logical_axes(cfg: OPTConfig, quantized: bool = False) -> Params:
-    ax = ("layers", "cache_batch", "cache_seq", "kv_heads", "head_dim")
+    ax = ("layers", "cache_batch", "kv_heads", "cache_seq", "head_dim")
     return {"k": ax, "v": ax}
 
 
@@ -166,15 +168,14 @@ def _block(x, lp, positions, cfg, layer_cache, kv_length=None,
         attn = dot_product_attention(q, kk, vv, causal=True, q_positions=positions)
         kv_out = (kk, vv)
     else:
-        k_cache, v_cache = layer_cache
-        rows = jnp.arange(x.shape[0])[:, None]
-        k_cache = k_cache.at[rows, positions].set(kk.astype(k_cache.dtype))
-        v_cache = v_cache.at[rows, positions].set(vv.astype(v_cache.dtype))
-        attn = dot_product_attention(
-            q, k_cache, v_cache, causal=True, q_positions=positions,
+        from substratus_tpu.ops.decode_attention import update_cache_and_attend
+
+        k_cache, v_cache = layer_cache  # [B, KH, S_cache, D]
+        attn, kv = update_cache_and_attend(
+            {"k": k_cache, "v": v_cache}, q, kk, vv, positions,
             kv_length=kv_length,
         )
-        kv_out = (k_cache, v_cache)
+        kv_out = (kv["k"], kv["v"])
 
     o = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]) + lp["bo"]
     if "wo" in lora:

@@ -126,3 +126,30 @@ def paged_cache_logical_axes(quantized: bool = False) -> Dict[str, tuple]:
         axes["k_scale"] = ax
         axes["v_scale"] = ax
     return axes
+
+
+def insert_prefill(
+    cache: Dict[str, jnp.ndarray],
+    kv: Dict[str, jnp.ndarray],
+    length: Optional[int] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Write a fresh prefill kv fragment into a slot cache, in place of
+    positions [0, S_frag).
+
+    `kv` is what forward() returns without a cache: {k, v: [L, B, S, KH, D]}
+    in activation layout. The slot cache (models/*.init_cache) stores
+    [L, B, KH, S, D] (+ [L, B, KH, S] scales when int8) — entries are
+    transposed and, for int8 caches, quantized per-vector on the way in
+    (ops.decode_attention.pack_fragment).
+    """
+    from substratus_tpu.ops.decode_attention import pack_fragment
+
+    frag = pack_fragment(cache, kv)
+    if length is None:
+        length = frag["k"].shape[3]
+    out = dict(cache)
+    for key, value in frag.items():
+        out[key] = (
+            cache[key].at[:, :, :, :length].set(value[:, :, :, :length])
+        )
+    return out

@@ -426,23 +426,16 @@ class Engine:
     def _build_insert(self):
         @partial(jax.jit, donate_argnums=(0,))
         def insert(cache, kv, slot):
-            # kv: {k, v} fragment [L, 1, Sb, KH, hd] (bf16 from prefill) ->
-            # write into cache[:, slot, :Sb], quantizing when the cache is
-            # int8.
-            if "k_scale" in cache:
-                from substratus_tpu.ops.quant import quantize_kv
+            # kv: {k, v} fragment [L, 1, Sb, KH, hd] (activation layout,
+            # bf16 from prefill) -> cache layout (quantized when int8),
+            # written into cache[:, slot, :, :Sb].
+            from substratus_tpu.ops.decode_attention import pack_fragment
 
-                kq, ks = quantize_kv(kv["k"])
-                vq, vs = quantize_kv(kv["v"])
-                frag = {"k": kq, "k_scale": ks, "v": vq, "v_scale": vs}
-            else:
-                frag = {
-                    "k": kv["k"].astype(cache["k"].dtype),
-                    "v": kv["v"].astype(cache["v"].dtype),
-                }
+            frag = pack_fragment(cache, kv)
             return {
                 key: jax.lax.dynamic_update_slice(
-                    cache[key], frag[key], (0, slot, 0, 0, 0)
+                    cache[key], frag[key],
+                    (0, slot) + (0,) * (cache[key].ndim - 2),
                 )
                 for key in cache
             }

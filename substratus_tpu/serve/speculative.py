@@ -85,9 +85,10 @@ def speculative_generate(
     d_cache = llama.init_cache(draft_cfg, 1, cache_len)
     t_logits, t_kv = llama.forward(target_params, prompt, target_cfg)
     _, d_kv = llama.forward(draft_params, prompt, draft_cfg)
-    for cache, kv in ((t_cache, t_kv), (d_cache, d_kv)):
-        cache["k"] = cache["k"].at[:, :, :n_prompt].set(kv["k"])
-        cache["v"] = cache["v"].at[:, :, :n_prompt].set(kv["v"])
+    from substratus_tpu.ops.kvcache import insert_prefill
+
+    t_cache = insert_prefill(t_cache, t_kv, n_prompt)
+    d_cache = insert_prefill(d_cache, d_kv, n_prompt)
 
     out: List[int] = []
     last = int(t_logits[0, -1].argmax())

@@ -25,6 +25,7 @@ _xb._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+from substratus_tpu.ops.kvcache import insert_prefill
 
 
 def greedy_decode(module, params, cfg, prompt, max_tokens, cache_len=256):
@@ -38,8 +39,7 @@ def greedy_decode(module, params, cfg, prompt, max_tokens, cache_len=256):
     logits, kv = module.forward(params, tokens, cfg)
     cache = module.init_cache(cfg, 1, cache_len)
     n = len(prompt)
-    cache["k"] = cache["k"].at[:, :, :n].set(kv["k"])
-    cache["v"] = cache["v"].at[:, :, :n].set(kv["v"])
+    cache = insert_prefill(cache, kv, n)
     out = [int(logits[0, -1].argmax())]
     pos = n
     while len(out) < max_tokens:

@@ -7,6 +7,7 @@ import pytest
 
 from substratus_tpu.load.hf import config_from_hf_falcon, convert_falcon_state_dict
 from substratus_tpu.models import falcon
+from substratus_tpu.ops.kvcache import insert_prefill
 
 
 def _hf_model(new_arch: bool):
@@ -60,8 +61,7 @@ def test_falcon_decode_and_engine():
     full, _ = falcon.forward(params, tokens, cfg)
     logits, kv = falcon.forward(params, tokens[:, :6], cfg)
     cache = falcon.init_cache(cfg, 1, 32)
-    cache["k"] = cache["k"].at[:, :, :6].set(kv["k"])
-    cache["v"] = cache["v"].at[:, :, :6].set(kv["v"])
+    cache = insert_prefill(cache, kv, 6)
     for i in range(6, 8):
         pos = jnp.full((1,), i, jnp.int32)
         step, cache = falcon.decode_step(

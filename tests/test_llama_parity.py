@@ -7,6 +7,7 @@ import pytest
 
 from substratus_tpu.load.hf import config_from_hf, convert_llama_state_dict
 from substratus_tpu.models import llama
+from substratus_tpu.ops.kvcache import insert_prefill
 
 
 @pytest.fixture(scope="module")
@@ -60,8 +61,7 @@ def test_decode_matches_prefill():
     prefill_len = 8
     logits, kv = llama.forward(params, tokens[:, :prefill_len], cfg)
     cache = llama.init_cache(cfg, 2, 32)
-    cache["k"] = cache["k"].at[:, :, :prefill_len].set(kv["k"])
-    cache["v"] = cache["v"].at[:, :, :prefill_len].set(kv["v"])
+    cache = insert_prefill(cache, kv, prefill_len)
 
     for i in range(prefill_len, 12):
         pos = jnp.full((2,), i, jnp.int32)

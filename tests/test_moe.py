@@ -7,6 +7,7 @@ import pytest
 from substratus_tpu.models import llama
 from substratus_tpu.parallel.mesh import build_mesh
 from substratus_tpu.train.trainer import TrainConfig, Trainer
+from substratus_tpu.ops.kvcache import insert_prefill
 
 
 @pytest.fixture(scope="module")
@@ -32,8 +33,7 @@ def test_moe_decode_consistency(moe_cfg):
 
     logits, kv = llama.forward(params, tokens[:, :8], moe_cfg)
     cache = llama.init_cache(moe_cfg, 2, 32)
-    cache["k"] = cache["k"].at[:, :, :8].set(kv["k"])
-    cache["v"] = cache["v"].at[:, :, :8].set(kv["v"])
+    cache = insert_prefill(cache, kv, 8)
     for i in range(8, 10):
         pos = jnp.full((2,), i, jnp.int32)
         step, cache = llama.decode_step(

@@ -7,6 +7,7 @@ import pytest
 
 from substratus_tpu.load.hf import config_from_hf_opt, convert_opt_state_dict
 from substratus_tpu.models import opt
+from substratus_tpu.ops.kvcache import insert_prefill
 
 
 @pytest.fixture(scope="module")
@@ -52,8 +53,7 @@ def test_opt_decode_matches_forward():
 
     logits, kv = opt.forward(params, tokens[:, :8], cfg)
     cache = opt.init_cache(cfg, 2, 32)
-    cache["k"] = cache["k"].at[:, :, :8].set(kv["k"])
-    cache["v"] = cache["v"].at[:, :, :8].set(kv["v"])
+    cache = insert_prefill(cache, kv, 8)
     for i in range(8, 10):
         pos = jnp.full((2,), i, jnp.int32)
         step, cache = opt.decode_step(

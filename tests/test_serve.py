@@ -12,6 +12,7 @@ import pytest
 from substratus_tpu.models import llama
 from substratus_tpu.serve.engine import Engine, EngineConfig, Request
 from substratus_tpu.serve.tokenizer import ByteTokenizer
+from substratus_tpu.ops.kvcache import insert_prefill
 
 
 @pytest.fixture(scope="module")
@@ -40,8 +41,7 @@ def test_greedy_matches_model_decode(engine):
         params, jnp.asarray([prompt], jnp.int32), cfg
     )
     cache = llama.init_cache(cfg, 1, 64)
-    cache["k"] = cache["k"].at[:, :, : len(prompt)].set(kv["k"])
-    cache["v"] = cache["v"].at[:, :, : len(prompt)].set(kv["v"])
+    cache = insert_prefill(cache, kv, len(prompt))
     tok = int(logits[0, -1].argmax())
     pos = len(prompt)
     for _ in range(6):

@@ -40,40 +40,14 @@ def _client(args):
 
             _FAKE_ENV = FakeEnv()
         return _FAKE_ENV.client
-    from substratus_tpu.kube.real import RealKube
+    from substratus_tpu.kube.config import default_client
 
-    kubeconfig = os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
-    if os.path.exists("/var/run/secrets/kubernetes.io/serviceaccount/token"):
-        return RealKube.in_cluster()
-    if os.path.exists(kubeconfig):
-        with open(kubeconfig) as f:
-            kc = yaml.safe_load(f)
-        ctx_name = kc.get("current-context")
-        ctx = next(c for c in kc["contexts"] if c["name"] == ctx_name)["context"]
-        cluster = next(
-            c for c in kc["clusters"] if c["name"] == ctx["cluster"]
-        )["cluster"]
-        user = next(u for u in kc["users"] if u["name"] == ctx["user"])["user"]
-        ca_file = cluster.get("certificate-authority")
-        if cluster.get("certificate-authority-data"):
-            import base64
-            import tempfile
-
-            ca_tmp = tempfile.NamedTemporaryFile(
-                suffix=".crt", delete=False, mode="wb"
-            )
-            ca_tmp.write(
-                base64.b64decode(cluster["certificate-authority-data"])
-            )
-            ca_tmp.close()
-            ca_file = ca_tmp.name
-        return RealKube(
-            cluster["server"],
-            token=user.get("token"),
-            ca_file=ca_file,
-            verify=not cluster.get("insecure-skip-tls-verify", False),
-        )
-    raise SystemExit("no kubeconfig found and not in-cluster (try --fake)")
+    # Full auth surface (in-cluster SA, tokens, client certs, exec plugins
+    # like gke-gcloud-auth-plugin) lives in kube/config.py.
+    try:
+        return default_client()
+    except FileNotFoundError:
+        raise SystemExit("no kubeconfig found and not in-cluster (try --fake)")
 
 
 def _load_manifests(path: str):
@@ -430,21 +404,61 @@ def cmd_logs(args) -> int:
         for line in lines[1:]:
             print(line)
         return 0
-    import shutil
-    import subprocess
-
-    kubectl = shutil.which("kubectl")
-    if kubectl is None:
-        raise SystemExit("kubectl not found on PATH")
-    selector = f"substratus.ai/object={kind.lower()}-{args.name}"
-    cmd = [kubectl, "-n", args.namespace, "logs", "-l", selector,
-           "--tail", str(args.tail)]
-    if args.follow:
-        cmd.append("-f")
     try:
-        return subprocess.call(cmd)
+        return stream_workload_logs(
+            client, args.namespace, kind, args.name,
+            tail=args.tail, follow=args.follow,
+        )
     except KeyboardInterrupt:
         return 0
+
+
+def workload_selector(kind: str, name: str) -> str:
+    """Label selector for the pods a CR's workload owns (the controllers
+    stamp substratus.ai/object on every workload pod template)."""
+    return f"substratus.ai/object={kind.lower()}-{name}"
+
+
+def stream_workload_logs(
+    client, namespace: str, kind: str, name: str,
+    *, tail: int = 20, follow: bool = False, emit=print,
+) -> int:
+    """Tail a CR's workload pod logs through the in-library pod log API
+    (kube/real.py) — no kubectl. Shared by `sub logs` and the TUI's log
+    stage. With follow, multi-pod workloads stream concurrently (one
+    follow generator never returns, so sequential iteration would hide
+    every pod after the first)."""
+    pods = client.list_selected(
+        "Pod", namespace, workload_selector(kind, name)
+    )
+    if not pods:
+        emit(f"no pods found for {kind.lower()}/{name}")
+        return 1
+    prefix = len(pods) > 1
+
+    def tail_one(pod_name: str) -> None:
+        for line in client.pod_logs(
+            namespace, pod_name, tail=tail, follow=follow
+        ):
+            emit(f"[{pod_name}] {line}" if prefix else line)
+
+    if follow and len(pods) > 1:
+        import threading
+
+        threads = [
+            threading.Thread(
+                target=tail_one, args=(p["metadata"]["name"],), daemon=True
+            )
+            for p in pods
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return 0
+    for pod in pods:
+        tail_one(pod["metadata"]["name"])
+    return 0
 
 
 def cmd_version(args) -> int:

@@ -9,8 +9,6 @@ so `--fake` drives the full composition against the in-process cluster.
 """
 from __future__ import annotations
 
-import os
-import time
 from typing import Any, Callable, Optional
 
 from substratus_tpu.cli import tui
@@ -75,6 +73,7 @@ def _logs_stage(args, client, obj) -> Optional[tui.LogView]:
     from substratus_tpu.cli.commands import (
         WORKLOAD_SUFFIX,
         fake_workload_status_lines,
+        stream_workload_logs,
     )
 
     kind, name = obj["kind"], obj["metadata"]["name"]
@@ -88,20 +87,7 @@ def _logs_stage(args, client, obj) -> Optional[tui.LogView]:
             ) or [f"no workload found for {kind.lower()}/{name}"]:
                 log(line)
             return obj
-        import shutil
-        import subprocess
-
-        kubectl = shutil.which("kubectl")
-        if kubectl is None:
-            log("kubectl not on PATH; skipping logs")
-            return obj
-        sel = f"substratus.ai/object={kind.lower()}-{name}"
-        proc = subprocess.Popen(
-            [kubectl, "-n", ns, "logs", "-l", sel, "--tail", "20"],
-            stdout=subprocess.PIPE, text=True,
-        )
-        for line in proc.stdout:
-            log(line.rstrip())
+        stream_workload_logs(client, ns, kind, name, emit=log)
         return obj
 
     return tui.LogView(f"{workload} status", work)
@@ -141,52 +127,24 @@ def notebook_flow(args) -> int:
     def devloop_stage(obj):
         if args.fake:
             return None  # no kubelet to forward to
+        import threading
+
+        from substratus_tpu.cli.sync import notebook_dev_loop
+
         name = obj["metadata"]["name"]
         ns = obj["metadata"]["namespace"]
-        pod = f"{name}-notebook"
+        stop = threading.Event()
 
         def work(log: Callable[[str], None]) -> Any:
-            import socket
-            import threading
-            import webbrowser
-
-            from substratus_tpu.cli.sync import (
-                port_forward,
-                sync_files_from_notebook,
+            notebook_dev_loop(
+                client, ns, f"{name}-notebook",
+                open_browser=not args.no_open, emit=log, stop=stop,
             )
-
-            stop = threading.Event()
-            threading.Thread(
-                target=sync_files_from_notebook,
-                args=(ns, pod, os.getcwd()),
-                kwargs={
-                    "stop": stop,
-                    "on_event": lambda e: log(f"sync: {e['op']} {e['path']}"),
-                },
-                daemon=True,
-            ).start()
-            fwd = threading.Thread(
-                target=port_forward, args=(ns, pod, 8888, 8888),
-                kwargs={"stop": stop}, daemon=True,
-            )
-            fwd.start()
-            url = "http://localhost:8888?token=default"
-            for _ in range(60):
-                try:
-                    with socket.create_connection(
-                        ("localhost", 8888), timeout=0.5
-                    ):
-                        break
-                except OSError:
-                    time.sleep(0.5)
-            log(f"forwarding :8888 — {url} (ctrl-c to stop)")
-            if not args.no_open:
-                webbrowser.open(url)
-            while fwd.is_alive():
-                fwd.join(timeout=1.0)
             return obj
 
-        return tui.LogView("notebook dev loop", work, height=12)
+        return tui.LogView(
+            "notebook dev loop", work, height=12, on_cancel=stop.set,
+        )
 
     seq = tui.Sequence([
         lambda _: tui.Picker("open which manifest?", docs, _manifest_label),

@@ -8,13 +8,6 @@ real cluster; under --fake the flow stops after readiness.
 """
 from __future__ import annotations
 
-import os
-import time
-import webbrowser
-from typing import Optional
-
-from substratus_tpu.api.types import KINDS
-
 
 def notebook_for_object(doc: dict) -> dict:
     """Convert a Model/Server/Dataset manifest to a Notebook (reference
@@ -45,7 +38,7 @@ def notebook_for_object(doc: dict) -> dict:
 
 
 def run_notebook(args, client) -> int:
-    from substratus_tpu.cli.commands import _load_manifests, _wait_ready, _FAKE_ENV
+    from substratus_tpu.cli.commands import _load_manifests, _wait_ready
 
     docs = _load_manifests(args.filename)
     if not docs:
@@ -69,40 +62,9 @@ def run_notebook(args, client) -> int:
 
     # Dev loop: file-sync + port-forward in the background, browser in front
     # (reference tui/notebook.go:65-91 composition).
-    import threading
+    from substratus_tpu.cli.sync import notebook_dev_loop
 
-    from substratus_tpu.cli.sync import port_forward, sync_files_from_notebook
-
-    stop = threading.Event()
-    pod = f"{name}-notebook"
-    threading.Thread(
-        target=sync_files_from_notebook,
-        args=(ns, pod, os.getcwd()),
-        kwargs={"stop": stop, "on_event": lambda e: print(f"  sync: {e['op']} {e['path']}")},
-        daemon=True,
-    ).start()
-    forward = threading.Thread(
-        target=port_forward, args=(ns, pod, 8888, 8888),
-        kwargs={"stop": stop}, daemon=True,
+    notebook_dev_loop(
+        client, ns, f"{name}-notebook", open_browser=not args.no_open,
     )
-    forward.start()
-
-    # Open the browser only once something is listening locally.
-    import socket
-
-    url = "http://localhost:8888?token=default"
-    for _ in range(60):
-        try:
-            with socket.create_connection(("localhost", 8888), timeout=0.5):
-                break
-        except OSError:
-            time.sleep(0.5)
-    print(f"notebook ready; forwarding :8888, opening {url} (ctrl-c to stop)")
-    if not args.no_open:
-        webbrowser.open(url)
-    try:
-        while forward.is_alive():
-            forward.join(timeout=1.0)
-    except KeyboardInterrupt:
-        stop.set()
     return 0

@@ -3,10 +3,10 @@
 
 Flow parity: ship the nbwatch binary into the pod, exec it, stream its JSON
 event lines, and mirror each changed file back locally (download on
-WRITE/CREATE, delete on REMOVE). Transport: kubectl subprocesses — the
-reference linked client-go for SPDY exec/cp; shelling out to kubectl keeps
-the same behavior without reimplementing the SPDY/WebSocket stack (a later
-round can inline it into kube/real.py).
+WRITE/CREATE, delete on REMOVE). Transport: the in-library WebSocket
+exec/port-forward in kube/real.py + kube/ws.py — no kubectl subprocesses
+(the reference links client-go for the same reason; a machine without
+kubectl on PATH works).
 """
 from __future__ import annotations
 
@@ -19,13 +19,6 @@ import time
 from typing import Callable, Optional
 
 NBWATCH_LOCAL = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-
-
-def _kubectl() -> str:
-    path = shutil.which("kubectl")
-    if path is None:
-        raise RuntimeError("kubectl not found on PATH (needed for notebook sync)")
-    return path
 
 
 def ensure_nbwatch_binary() -> str:
@@ -44,6 +37,7 @@ def ensure_nbwatch_binary() -> str:
 
 
 def sync_files_from_notebook(
+    client,
     namespace: str,
     pod: str,
     local_dir: str,
@@ -52,59 +46,59 @@ def sync_files_from_notebook(
     stop: Optional[threading.Event] = None,
 ) -> None:
     """Stream nbwatch events from the pod and mirror files locally."""
-    kubectl = _kubectl()
     # The runtime image ships nbwatch at /usr/local/bin (Dockerfile); use it
     # — copying a host-built binary breaks on arch mismatch (e.g. arm64
     # laptop -> amd64 pod). Copy only as a fallback for foreign images.
     in_pod = "/usr/local/bin/nbwatch"
-    probe = subprocess.run(
-        [kubectl, "-n", namespace, "exec", pod, "--", "test", "-x", in_pod],
-        capture_output=True,
-    )
-    if probe.returncode != 0:
+    rc, _, _ = client.pod_exec(namespace, pod, ["test", "-x", in_pod])
+    if rc != 0:
         binary = ensure_nbwatch_binary()
         in_pod = "/tmp/nbwatch"
-        subprocess.run(
-            [kubectl, "-n", namespace, "cp", binary, f"{pod}:{in_pod}"],
-            check=True,
-        )
-        subprocess.run(
-            [kubectl, "-n", namespace, "exec", pod, "--", "chmod", "+x",
-             in_pod],
-            check=True,
-        )
-    proc = subprocess.Popen(
-        [kubectl, "-n", namespace, "exec", pod, "--", in_pod, container_dir],
-        stdout=subprocess.PIPE,
-        text=True,
-    )
+        if not client.cp_to_pod(namespace, pod, binary, in_pod):
+            raise RuntimeError(f"failed to copy nbwatch into {pod}")
+        rc, _, err = client.pod_exec(namespace, pod, ["chmod", "+x", in_pod])
+        if rc != 0:
+            raise RuntimeError(
+                f"chmod +x {in_pod} failed in {pod}: "
+                f"{err.decode(errors='replace').strip()}"
+            )
+
+    stream = client.pod_exec_stream(namespace, pod, [in_pod, container_dir])
     try:
-        for line in proc.stdout:
+        buf = b""
+        for channel, data in stream.chunks():
             if stop is not None and stop.is_set():
                 break
-            try:
-                event = json.loads(line)
-            except json.JSONDecodeError:
+            if channel != 1:  # stdout only
                 continue
-            rel = os.path.relpath(event["path"], container_dir)
-            local_path = os.path.join(local_dir, rel)
-            if event["op"] == "REMOVE":
-                if os.path.exists(local_path):
-                    os.unlink(local_path)
-            else:
-                os.makedirs(os.path.dirname(local_path), exist_ok=True)
-                subprocess.run(
-                    [kubectl, "-n", namespace, "cp",
-                     f"{pod}:{event['path']}", local_path],
-                    check=False,
-                )
-            if on_event:
-                on_event(event)
+            buf += data
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                _apply_event(client, namespace, pod, event, container_dir,
+                             local_dir)
+                if on_event:
+                    on_event(event)
     finally:
-        proc.terminate()
+        stream.close()
+
+
+def _apply_event(client, namespace, pod, event, container_dir,
+                 local_dir) -> None:
+    rel = os.path.relpath(event["path"], container_dir)
+    local_path = os.path.join(local_dir, rel)
+    if event["op"] == "REMOVE":
+        if os.path.exists(local_path):
+            os.unlink(local_path)
+    else:
+        client.cp_from_pod(namespace, pod, event["path"], local_path)
 
 
 def port_forward(
+    client,
     namespace: str,
     pod: str,
     local_port: int,
@@ -112,28 +106,98 @@ def port_forward(
     stop: Optional[threading.Event] = None,
     max_retries: int = 10,
 ) -> None:
-    """kubectl port-forward with exponential-backoff restart (reference
+    """In-library port-forward with exponential-backoff restart (reference
     tui/portforward.go:20-61)."""
-    kubectl = _kubectl()
     delay = 1.0
     retries = 0
     while not (stop is not None and stop.is_set()):
         started = time.monotonic()
-        proc = subprocess.Popen(
-            [kubectl, "-n", namespace, "port-forward", f"pod/{pod}",
-             f"{local_port}:{remote_port}"],
-        )
-        code = proc.wait()
-        if stop is not None and stop.is_set():
-            return
-        if time.monotonic() - started > 10.0:
-            # The forward was healthy for a while; an idle disconnect is not
-            # a failure — reset the budget so long sessions never die.
-            retries, delay = 0, 1.0
-        retries += 1
-        if retries > max_retries:
-            raise RuntimeError(
-                f"port-forward failed {max_retries} times (last exit {code})"
+        try:
+            client.port_forward(
+                namespace, pod, local_port, remote_port, stop=stop
             )
-        time.sleep(delay)
-        delay = min(delay * 2, 30.0)
+            return  # clean stop
+        except Exception as e:
+            if stop is not None and stop.is_set():
+                return
+            if time.monotonic() - started > 10.0:
+                # The forward was healthy for a while; an idle disconnect is
+                # not a failure — reset the budget so long sessions never
+                # die.
+                retries, delay = 0, 1.0
+            retries += 1
+            if retries > max_retries:
+                raise RuntimeError(
+                    f"port-forward failed {max_retries} times (last: {e})"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+
+
+def _probe_forward(port: int, timeout: float = 2.0) -> bool:
+    """True once the forward round-trips to the pod. A bare TCP connect is
+    not enough: the in-library forwarder's local listener accepts the
+    instant it binds, before any pod-side stream exists — readiness means
+    bytes actually come back from the far end."""
+    import socket
+
+    try:
+        with socket.create_connection(("localhost", port), timeout) as conn:
+            conn.sendall(b"GET /api HTTP/1.0\r\n\r\n")
+            conn.settimeout(timeout)
+            return bool(conn.recv(1))
+    except OSError:
+        return False
+
+
+def notebook_dev_loop(
+    client,
+    namespace: str,
+    pod: str,
+    *,
+    local_dir: Optional[str] = None,
+    port: int = 8888,
+    open_browser: bool = True,
+    emit: Callable[[str], None] = print,
+    stop: Optional[threading.Event] = None,
+) -> None:
+    """The composed notebook dev loop both `sub notebook` frontends share
+    (plain CLI and TUI): background file-sync + port-forward, wait for the
+    local port to answer, open the browser, then hold until interrupted —
+    setting `stop` on every exit path so both workers wind down."""
+    import socket
+    import webbrowser
+
+    stop = stop or threading.Event()
+    threading.Thread(
+        target=sync_files_from_notebook,
+        args=(client, namespace, pod, local_dir or os.getcwd()),
+        kwargs={
+            "stop": stop,
+            "on_event": lambda e: emit(f"sync: {e['op']} {e['path']}"),
+        },
+        daemon=True,
+    ).start()
+    fwd = threading.Thread(
+        target=port_forward, args=(client, namespace, pod, port, port),
+        kwargs={"stop": stop}, daemon=True,
+    )
+    fwd.start()
+
+    url = f"http://localhost:{port}?token=default"
+    for _ in range(60):
+        if stop.is_set():
+            return
+        if _probe_forward(port):
+            break
+        time.sleep(0.5)
+    emit(f"forwarding :{port} — {url} (ctrl-c to stop)")
+    if open_browser:
+        webbrowser.open(url)
+    try:
+        while fwd.is_alive() and not stop.is_set():
+            fwd.join(timeout=1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()

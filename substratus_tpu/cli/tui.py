@@ -96,6 +96,9 @@ class Model:
     def view(self) -> str:
         return ""
 
+    def cancel(self) -> None:  # user quit while this stage was running
+        pass
+
 
 _KEYMAP = {
     "\x1b[A": "up", "\x1b[B": "down", "\x1b[C": "right", "\x1b[D": "left",
@@ -193,15 +196,8 @@ class Runtime:
             model.start(ctx)
             while True:
                 frame = model.view()
-                self._paint(frame, last_lines)
-                last_lines = frame.count("\n") + 1
-                # cbreak keeps ISIG: Ctrl-C arrives as KeyboardInterrupt in
-                # this blocked get(), not as a '\x03' byte — treat it as a
-                # clean quit, never a traceback.
-                try:
-                    msg = ctx.queue.get()
-                except KeyboardInterrupt:
-                    raise Quit(None)
+                last_lines = self._paint(frame, last_lines)
+                msg = ctx.queue.get()
                 if isinstance(msg, KeyMsg) and msg.key == "ctrl-c":
                     raise Quit(None)
                 model.update(ctx, msg)
@@ -210,7 +206,15 @@ class Runtime:
                 if model.done:
                     self._paint(model.view(), last_lines, final=True)
                     return model.result
+        except KeyboardInterrupt:
+            # cbreak keeps ISIG, so Ctrl-C raises wherever the main thread
+            # happens to be (queue.get, update, view, paint) — always a
+            # clean quit, never a traceback.
+            model.cancel()
+            self._paint(model.view(), last_lines, final=True)
+            return None
         except Quit as q:
+            model.cancel()
             self._paint(model.view(), last_lines, final=True)
             if isinstance(q.result, BaseException):
                 raise q.result
@@ -221,7 +225,21 @@ class Runtime:
             self.stdout.flush()
             termios.tcsetattr(fd, termios.TCSADRAIN, old)
 
-    def _paint(self, frame: str, last_lines: int, final: bool = False) -> None:
+    def _paint(self, frame: str, last_lines: int, final: bool = False) -> int:
+        """Repaint in place; returns the painted line count.
+
+        Each logical line is truncated to the terminal width: a wrapped
+        line would consume extra rows the cursor-up math can't see, and
+        stale half-frames would stack above. The final paint keeps full
+        lines (it scrolls naturally into scrollback).
+        """
+        import shutil
+
+        if not final:
+            width = shutil.get_terminal_size().columns
+            frame = "\n".join(
+                line[: max(width - 1, 1)] for line in frame.split("\n")
+            )
         # Move up over the previous frame, erase below, draw.
         out = ""
         if last_lines:
@@ -231,6 +249,7 @@ class Runtime:
             out += "\n"
         self.stdout.write(out)
         self.stdout.flush()
+        return frame.count("\n") + 1
 
 
 class Sequence(Model):
@@ -243,6 +262,10 @@ class Sequence(Model):
         self.history: List[str] = []
         self._ctx: Optional[Context] = None
         self._last_result: Any = None
+
+    def cancel(self) -> None:
+        if self.current is not None:
+            self.current.cancel()
 
     def start(self, ctx: Context) -> None:
         self._ctx = ctx
@@ -411,11 +434,17 @@ class LogView(Model):
     DoneMsg. (reference: podsModel log pane)."""
 
     def __init__(self, title: str, fn: Callable[[Callable[[str], None]], Any],
-                 height: int = 8):
+                 height: int = 8,
+                 on_cancel: Optional[Callable[[], None]] = None):
         self.title = title
         self.fn = fn
         self.lines: List[str] = []
         self.height = height
+        self.on_cancel = on_cancel
+
+    def cancel(self) -> None:
+        if self.on_cancel is not None:
+            self.on_cancel()
 
     def start(self, ctx: Context) -> None:
         def run():

@@ -1,0 +1,127 @@
+"""kubeconfig loading with the full auth surface client-go gives the
+reference for free (internal/cli/notebook.go:37-50): bearer tokens,
+client certificates (inline -data or file paths), exec credential
+plugins (client.authentication.k8s.io ExecCredential — what GKE's
+gke-gcloud-auth-plugin speaks), CA bundles, and insecure-skip-tls.
+
+Resolution order per kubeconfig `user`:
+  1. token / tokenFile
+  2. client-certificate(-data) + client-key(-data)
+  3. exec plugin -> ExecCredential {token | clientCertificateData+KeyData}
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import yaml
+
+from substratus_tpu.kube.real import RealKube
+
+SA_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+
+
+def _write_tmp(content: str, suffix: str) -> str:
+    tmp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False, mode="w")
+    tmp.write(content)
+    tmp.close()
+    return tmp.name
+
+
+def _materialize(data_b64: Optional[str], path: Optional[str],
+                 suffix: str) -> Optional[str]:
+    """Inline base64 -data wins over the file path; returns a file path."""
+    if data_b64:
+        return _write_tmp(base64.b64decode(data_b64).decode(), suffix)
+    return path
+
+
+def _run_exec_plugin(spec: dict) -> dict:
+    """Run a client-go exec credential plugin; returns ExecCredential
+    .status ({token} or {clientCertificateData, clientKeyData})."""
+    env = dict(os.environ)
+    for pair in spec.get("env") or []:
+        env[pair["name"]] = pair["value"]
+    api_version = spec.get("apiVersion",
+                           "client.authentication.k8s.io/v1beta1")
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "apiVersion": api_version,
+        "kind": "ExecCredential",
+        "spec": {"interactive": False},
+    })
+    cmd = [spec["command"], *(spec.get("args") or [])]
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=60,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"credential plugin {spec['command']!r} failed: "
+            f"{proc.stderr.strip()[:300]}"
+        )
+    cred = json.loads(proc.stdout)
+    return cred.get("status") or {}
+
+
+def client_from_kubeconfig(
+    path: Optional[str] = None, context: Optional[str] = None
+) -> RealKube:
+    """Build a RealKube from a kubeconfig file (default: $KUBECONFIG or
+    ~/.kube/config), honoring the named (or current-) context."""
+    path = path or os.environ.get(
+        "KUBECONFIG", os.path.expanduser("~/.kube/config")
+    )
+    with open(path) as f:
+        kc = yaml.safe_load(f)
+
+    ctx_name = context or kc.get("current-context")
+    ctx = next(c for c in kc["contexts"] if c["name"] == ctx_name)["context"]
+    cluster = next(
+        c for c in kc["clusters"] if c["name"] == ctx["cluster"]
+    )["cluster"]
+    user = next(u for u in kc["users"] if u["name"] == ctx["user"])["user"]
+
+    ca_file = _materialize(
+        cluster.get("certificate-authority-data"),
+        cluster.get("certificate-authority"),
+        ".crt",
+    )
+
+    token = user.get("token")
+    if not token and user.get("tokenFile"):
+        with open(user["tokenFile"]) as f:
+            token = f.read().strip()
+    cert_file = _materialize(
+        user.get("client-certificate-data"),
+        user.get("client-certificate"), ".crt",
+    )
+    key_file = _materialize(
+        user.get("client-key-data"), user.get("client-key"), ".key",
+    )
+
+    if not token and not cert_file and user.get("exec"):
+        status = _run_exec_plugin(user["exec"])
+        token = status.get("token")
+        # ExecCredential cert/key fields hold PEM text directly.
+        if status.get("clientCertificateData"):
+            cert_file = _write_tmp(status["clientCertificateData"], ".crt")
+            key_file = _write_tmp(status["clientKeyData"], ".key")
+
+    return RealKube(
+        cluster["server"],
+        token=token,
+        ca_file=ca_file,
+        verify=not cluster.get("insecure-skip-tls-verify", False),
+        cert_file=cert_file,
+        key_file=key_file,
+    )
+
+
+def default_client() -> RealKube:
+    """In-cluster service account when mounted, else kubeconfig."""
+    if os.path.exists(SA_TOKEN):
+        return RealKube.in_cluster()
+    return client_from_kubeconfig()

@@ -3,20 +3,24 @@
 The reference uses client-go/controller-runtime (internal/client/client.go).
 This implementation speaks the same REST surface with stdlib HTTP: CRUD on
 the substratus.ai CRs and the core/batch/apps/jobset resources the
-controllers create, plus watch streams feeding Manager listeners. In-cluster
-config comes from the standard serviceaccount token mount; out-of-cluster
-from $KUBECONFIG (token/insecure-skip-tls only — exec plugins are out of
-scope for round 1).
+controllers create, watch streams feeding Manager listeners, and the pod
+streaming subresources — logs (REST), exec and port-forward (WebSocket,
+kube/ws.py) — that the reference reaches through client-go SPDY
+(internal/client/sync.go:137-176, port_forward.go:21-44). In-cluster config
+comes from the standard serviceaccount token mount; out-of-cluster from
+kubeconfig via kube/config.py (tokens, client certs, exec plugins).
 """
 from __future__ import annotations
 
 import json
 import os
+import socket
 import ssl
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from substratus_tpu.api.types import GROUP, VERSION
 from substratus_tpu.kube.client import (
@@ -60,6 +64,8 @@ class RealKube(KubeClient):
         token: Optional[str] = None,
         ca_file: Optional[str] = None,
         verify: bool = True,
+        cert_file: Optional[str] = None,
+        key_file: Optional[str] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
@@ -70,6 +76,8 @@ class RealKube(KubeClient):
             self._ctx = ssl._create_unverified_context()
         else:
             self._ctx = ssl.create_default_context()
+        if cert_file:
+            self._ctx.load_cert_chain(cert_file, key_file)
         self._watch_threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -199,3 +207,220 @@ class RealKube(KubeClient):
 
     def stop(self) -> None:
         self._stop.set()
+
+    # -- pod streaming subresources (logs / exec / port-forward) -----------
+
+    def list_selected(self, kind: str, namespace: str,
+                      label_selector: str) -> List[Obj]:
+        out = self._request(
+            "GET", self._path(kind, namespace),
+            query="labelSelector=" + urllib.parse.quote(label_selector),
+        )
+        items = out.get("items", [])
+        for it in items:
+            it.setdefault("kind", kind)
+        return items
+
+    def pod_logs(
+        self,
+        namespace: str,
+        pod: str,
+        *,
+        container: Optional[str] = None,
+        tail: Optional[int] = None,
+        follow: bool = False,
+    ) -> Iterator[str]:
+        """Stream a pod's log lines (GET .../pods/{pod}/log)."""
+        params = {}
+        if container:
+            params["container"] = container
+        if tail is not None:
+            params["tailLines"] = str(tail)
+        if follow:
+            params["follow"] = "true"
+        url = (
+            self.base_url + self._path("Pod", namespace, pod, "log")
+            + ("?" + urllib.parse.urlencode(params) if params else "")
+        )
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                req, context=self._ctx, timeout=None if follow else 30
+            ) as r:
+                for line in r:
+                    yield line.decode(errors="replace").rstrip("\n")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise NotFound(pod)
+            raise KubeError(f"logs {pod}: {e.code} {e.read()[:300]!r}")
+
+    def _ws_connect(self, path: str, query: str, subprotocols):
+        from substratus_tpu.kube.ws import WebSocket
+
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return WebSocket.connect(
+            self.base_url + path + "?" + query,
+            headers=headers,
+            subprotocols=subprotocols,
+            ssl_context=self._ctx if self.base_url.startswith("https") else None,
+        )
+
+    def pod_exec_stream(
+        self,
+        namespace: str,
+        pod: str,
+        command: List[str],
+        *,
+        stdin: bool = False,
+        container: Optional[str] = None,
+    ):
+        """Open exec against the pod; returns a kube.ws.ExecStream."""
+        from substratus_tpu.kube.ws import ExecStream
+
+        params = [("stdout", "1"), ("stderr", "1")]
+        if stdin:
+            params.append(("stdin", "1"))
+        if container:
+            params.append(("container", container))
+        params += [("command", c) for c in command]
+        ws = self._ws_connect(
+            self._path("Pod", namespace, pod, "exec"),
+            urllib.parse.urlencode(params),
+            ("v4.channel.k8s.io",),
+        )
+        return ExecStream(ws)
+
+    def pod_exec(
+        self,
+        namespace: str,
+        pod: str,
+        command: List[str],
+        *,
+        stdin_data: Optional[bytes] = None,
+        container: Optional[str] = None,
+    ):
+        """Run a command to completion -> (rc, stdout, stderr)."""
+        stream = self.pod_exec_stream(
+            namespace, pod, command,
+            stdin=stdin_data is not None, container=container,
+        )
+        if stdin_data is not None:
+            for off in range(0, len(stdin_data), 65536):
+                stream.send_stdin(stdin_data[off:off + 65536])
+        out, err, status = stream.run()
+        rc = 0
+        if status.get("status") == "Failure":
+            rc = 1
+            for cause in (status.get("details") or {}).get("causes") or []:
+                if cause.get("reason") == "ExitCode":
+                    rc = int(cause.get("message", 1))
+        return rc, out, err
+
+    def cp_from_pod(self, namespace: str, pod: str, remote_path: str,
+                    local_path: str) -> bool:
+        """Download one file (exec `cat`; the reference's sync.go uses the
+        same per-file strategy through its cp helper)."""
+        rc, out, err = self.pod_exec(
+            namespace, pod, ["cat", remote_path]
+        )
+        if rc != 0:
+            return False
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(out)
+        return True
+
+    def cp_to_pod(self, namespace: str, pod: str, local_path: str,
+                  remote_path: str) -> bool:
+        """Upload one file. `head -c N > path` consumes exactly the payload
+        size, so completion needs no stdin-EOF signal (the v4 channel
+        protocol has none)."""
+        with open(local_path, "rb") as f:
+            data = f.read()
+        rc, _, err = self.pod_exec(
+            namespace, pod,
+            ["sh", "-c", f"head -c {len(data)} > {remote_path}"],
+            stdin_data=data,
+        )
+        return rc == 0
+
+    def port_forward(
+        self,
+        namespace: str,
+        pod: str,
+        local_port: int,
+        remote_port: int,
+        *,
+        stop: Optional[threading.Event] = None,
+        ready: Optional[threading.Event] = None,
+    ) -> None:
+        """Forward localhost:local_port -> pod:remote_port until `stop`.
+
+        Accept loop on a local listener; each TCP connection gets its own
+        WebSocket stream pair (the portforward.k8s.io protocol is
+        per-connection), pumped by a pair of threads.
+        """
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", local_port))
+        listener.listen(8)
+        listener.settimeout(0.5)
+        if ready is not None:
+            ready.set()
+        try:
+            while not (stop is not None and stop.is_set()):
+                try:
+                    conn, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(
+                    target=self._forward_one,
+                    args=(namespace, pod, remote_port, conn, stop),
+                    daemon=True,
+                ).start()
+        finally:
+            listener.close()
+
+    def _forward_one(self, namespace, pod, remote_port, conn, stop) -> None:
+        from substratus_tpu.kube.ws import PortForwardStream
+
+        try:
+            ws = self._ws_connect(
+                self._path("Pod", namespace, pod, "portforward"),
+                urllib.parse.urlencode([("ports", str(remote_port))]),
+                ("portforward.k8s.io",),
+            )
+        except Exception:
+            conn.close()
+            return
+        stream = PortForwardStream(ws)
+
+        def pump_out():
+            try:
+                for chunk in stream.chunks():
+                    conn.sendall(chunk)
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=pump_out, daemon=True)
+        t.start()
+        try:
+            while not (stop is not None and stop.is_set()):
+                data = conn.recv(65536)
+                if not data:
+                    break
+                stream.send(data)
+        except OSError:
+            pass
+        finally:
+            stream.close()
+            conn.close()

@@ -1,0 +1,258 @@
+"""Minimal RFC 6455 WebSocket client for the Kubernetes streaming APIs.
+
+The reference reaches pods through client-go's SPDY/WebSocket executor
+(internal/client/sync.go:137-176, port_forward.go:21-44). Kubernetes has
+supported WebSocket transports for exec/attach/port-forward since long
+before SPDY's deprecation, and a WebSocket client is small enough to own:
+this module implements the client half of RFC 6455 over the stdlib
+(http/ssl sockets) — handshake, masked client frames, fragmented reads,
+ping/pong/close — plus the two K8s subprotocols built on it:
+
+* `v4.channel.k8s.io` (exec/attach): every binary message is prefixed
+  with one channel byte — 0 stdin, 1 stdout, 2 stderr, 3 error/status,
+  4 resize.
+* `portforward.k8s.io`: stream pairs per forwarded port — even channel
+  data, odd channel error; each stream's first message is the port
+  number (2 bytes little-endian).
+
+No external websocket dependency, no kubectl subprocess.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import ssl
+import struct
+from typing import Iterator, Optional, Tuple
+from urllib.parse import urlsplit
+
+# K8s channel-protocol channel ids (v4.channel.k8s.io)
+STDIN, STDOUT, STDERR, ERROR, RESIZE = 0, 1, 2, 3, 4
+
+_OP_TEXT, _OP_BINARY, _OP_CLOSE, _OP_PING, _OP_PONG = 0x1, 0x2, 0x8, 0x9, 0xA
+
+
+class WSError(RuntimeError):
+    pass
+
+
+class WebSocket:
+    """One client WebSocket connection (blocking I/O)."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = b""
+        self.closed = False
+
+    # -- connection -------------------------------------------------------
+
+    @classmethod
+    def connect(
+        cls,
+        url: str,
+        *,
+        headers: Optional[dict] = None,
+        subprotocols: Tuple[str, ...] = (),
+        ssl_context: Optional[ssl.SSLContext] = None,
+        timeout: float = 30.0,
+    ) -> "WebSocket":
+        """Open and upgrade. `url` is https:// or wss:// (or http/ws)."""
+        parts = urlsplit(url)
+        tls = parts.scheme in ("https", "wss")
+        port = parts.port or (443 if tls else 80)
+        raw = socket.create_connection((parts.hostname, port), timeout=timeout)
+        if tls:
+            ctx = ssl_context or ssl.create_default_context()
+            raw = ctx.wrap_socket(raw, server_hostname=parts.hostname)
+
+        key = base64.b64encode(os.urandom(16)).decode()
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        lines = [
+            f"GET {path or '/'} HTTP/1.1",
+            f"Host: {parts.hostname}:{port}",
+            "Upgrade: websocket",
+            "Connection: Upgrade",
+            f"Sec-WebSocket-Key: {key}",
+            "Sec-WebSocket-Version: 13",
+        ]
+        if subprotocols:
+            lines.append(
+                "Sec-WebSocket-Protocol: " + ", ".join(subprotocols)
+            )
+        for name, value in (headers or {}).items():
+            lines.append(f"{name}: {value}")
+        raw.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+
+        # Read the upgrade response head.
+        head = b""
+        while b"\r\n\r\n" not in head:
+            chunk = raw.recv(4096)
+            if not chunk:
+                raise WSError("connection closed during handshake")
+            head += chunk
+            if len(head) > 65536:
+                raise WSError("oversized handshake response")
+        head, rest = head.split(b"\r\n\r\n", 1)
+        status = head.split(b"\r\n", 1)[0].decode(errors="replace")
+        if " 101 " not in status + " ":
+            body = rest[:300].decode(errors="replace")
+            raise WSError(f"upgrade refused: {status} {body}")
+        # The timeout guarded the handshake only: exec/port-forward streams
+        # legitimately idle far longer than any fixed timeout.
+        raw.settimeout(None)
+        ws = cls(raw)
+        ws._buf = rest
+        return ws
+
+    # -- frames -----------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise WSError("connection closed mid-frame")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def send(self, payload: bytes, opcode: int = _OP_BINARY) -> None:
+        """Send one masked frame (clients MUST mask, RFC 6455 §5.3)."""
+        mask = os.urandom(4)
+        n = len(payload)
+        head = bytes([0x80 | opcode])
+        if n < 126:
+            head += bytes([0x80 | n])
+        elif n < 65536:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            head += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self._sock.sendall(head + mask + masked)
+
+    def recv(self) -> Optional[bytes]:
+        """Next complete message payload; None once the peer closes.
+        Ping/pong handled internally; fragmented messages reassembled."""
+        message = b""
+        while True:
+            b1, b2 = self._read_exact(2)
+            fin, opcode = b1 & 0x80, b1 & 0x0F
+            masked, n = b2 & 0x80, b2 & 0x7F
+            if n == 126:
+                (n,) = struct.unpack(">H", self._read_exact(2))
+            elif n == 127:
+                (n,) = struct.unpack(">Q", self._read_exact(8))
+            mask = self._read_exact(4) if masked else b""
+            payload = self._read_exact(n)
+            if mask:
+                payload = bytes(
+                    b ^ mask[i % 4] for i, b in enumerate(payload)
+                )
+            if opcode == _OP_PING:
+                self.send(payload, _OP_PONG)
+                continue
+            if opcode == _OP_PONG:
+                continue
+            if opcode == _OP_CLOSE:
+                if not self.closed:
+                    self.closed = True
+                    try:
+                        self.send(payload[:2], _OP_CLOSE)
+                    except OSError:
+                        pass
+                return None
+            message += payload
+            if fin:
+                return message
+
+    def messages(self) -> Iterator[bytes]:
+        while True:
+            m = self.recv()
+            if m is None:
+                return
+            yield m
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                self.send(struct.pack(">H", 1000), _OP_CLOSE)
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ExecStream:
+    """`v4.channel.k8s.io` channel demux over one WebSocket (exec/attach)."""
+
+    def __init__(self, ws: WebSocket):
+        self.ws = ws
+
+    def send_stdin(self, data: bytes) -> None:
+        self.ws.send(bytes([STDIN]) + data)
+
+    def chunks(self) -> Iterator[Tuple[int, bytes]]:
+        """Yield (channel, data) pairs until the server closes."""
+        for msg in self.ws.messages():
+            if not msg:
+                continue
+            yield msg[0], msg[1:]
+
+    def run(self) -> Tuple[bytes, bytes, dict]:
+        """Drain to completion -> (stdout, stderr, status). status is the
+        V1Status JSON from the error channel ({} means success)."""
+        out, err, status = b"", b"", {}
+        for channel, data in self.chunks():
+            if channel == STDOUT:
+                out += data
+            elif channel == STDERR:
+                err += data
+            elif channel == ERROR:
+                try:
+                    status = json.loads(data)
+                except json.JSONDecodeError:
+                    status = {"status": "Failure",
+                              "message": data.decode(errors="replace")}
+        self.ws.close()
+        return out, err, status
+
+    def close(self) -> None:
+        self.ws.close()
+
+
+class PortForwardStream:
+    """`portforward.k8s.io` single-port stream pair over one WebSocket.
+
+    K8s sends each stream's port announcement (2 bytes LE) as the first
+    message on channels 0 (data) and 1 (error); afterwards channel 0
+    carries the TCP bytes both ways.
+    """
+
+    def __init__(self, ws: WebSocket):
+        self.ws = ws
+        self._announced: set = set()
+
+    def send(self, data: bytes) -> None:
+        self.ws.send(b"\x00" + data)
+
+    def chunks(self) -> Iterator[bytes]:
+        """Yield remote->local data chunks (announcements skipped, error
+        channel raises)."""
+        for msg in self.ws.messages():
+            if not msg:
+                continue
+            channel, data = msg[0], msg[1:]
+            if channel not in self._announced:
+                self._announced.add(channel)  # port announcement frame
+                continue
+            if channel == 1 and data:
+                raise WSError(f"port-forward: {data.decode(errors='replace')}")
+            if channel == 0:
+                yield data
+
+    def close(self) -> None:
+        self.ws.close()

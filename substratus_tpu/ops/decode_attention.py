@@ -97,14 +97,8 @@ def _kernel(
     pos_ref,  # scalar prefetch: [B] int32
     q_ref,    # [1, KH, G, D]
     k_ref,    # [1, KH, bs, D]
-    ks_ref,   # [1, KH, bs] f32
-    v_ref,    # [1, KH, bs, D]
-    vs_ref,   # [1, KH, bs] f32
-    o_ref,    # [1, KH, G, D]
-    m_scratch,    # [KH*G8, 128] f32
-    l_scratch,    # [KH*G8, 128] f32
-    acc_scratch,  # [KH*G8, D] f32
-    *,
+    *rest,    # quantized: ks [1,KH,bs] f32, v, vs, out, 3 scratches;
+    #           unquantized: v, out, 3 scratches (no scale operands at all)
     scale: float,
     kh: int,
     group: int,
@@ -112,6 +106,12 @@ def _kernel(
     num_s_blocks: int,
     quantized: bool,
 ):
+    if quantized:
+        ks_ref, v_ref, vs_ref, o_ref = rest[:4]
+    else:
+        ks_ref = vs_ref = None
+        v_ref, o_ref = rest[:2]
+    m_scratch, l_scratch, acc_scratch = rest[-3:]
     ib = pl.program_id(0)
     isb = pl.program_id(1)
     pos = pos_ref[ib]
@@ -181,25 +181,30 @@ def _pallas(q, k, v, positions, k_scale, v_scale, block_s, interpret):
         block_s -= 1
     nsb = s_len // block_s
     quantized = k_scale is not None
-    if not quantized:
-        # Uniform kernel signature: unit scales (tiny, [B, KH, S] f32).
-        k_scale = jnp.ones((b, kh, s_len), jnp.float32)
-        v_scale = jnp.ones((b, kh, s_len), jnp.float32)
     qr = q.reshape(b, kh, group, d)
     kernel = functools.partial(
         _kernel, scale=d ** -0.5, kh=kh, group=group,
         block_s=block_s, num_s_blocks=nsb, quantized=quantized,
     )
+    kv_spec = pl.BlockSpec(
+        (1, kh, block_s, d), lambda ib, isb, pos: (ib, 0, isb, 0)
+    )
+    scale_spec = pl.BlockSpec(
+        (1, kh, block_s), lambda ib, isb, pos: (ib, 0, isb)
+    )
+    q_spec = pl.BlockSpec(
+        (1, kh, group, d), lambda ib, isb, pos: (ib, 0, 0, 0)
+    )
+    if quantized:
+        in_specs = [q_spec, kv_spec, scale_spec, kv_spec, scale_spec]
+        operands = (qr, k, k_scale, v, v_scale)
+    else:
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (qr, k, v)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, nsb),
-        in_specs=[
-            pl.BlockSpec((1, kh, group, d), lambda ib, isb, pos: (ib, 0, 0, 0)),
-            pl.BlockSpec((1, kh, block_s, d), lambda ib, isb, pos: (ib, 0, isb, 0)),
-            pl.BlockSpec((1, kh, block_s), lambda ib, isb, pos: (ib, 0, isb)),
-            pl.BlockSpec((1, kh, block_s, d), lambda ib, isb, pos: (ib, 0, isb, 0)),
-            pl.BlockSpec((1, kh, block_s), lambda ib, isb, pos: (ib, 0, isb)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, kh, group, d), lambda ib, isb, pos: (ib, 0, 0, 0)
         ),
@@ -217,7 +222,7 @@ def _pallas(q, k, v, positions, k_scale, v_scale, block_s, interpret):
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(positions.astype(jnp.int32), qr, k, k_scale, v, v_scale)
+    )(positions.astype(jnp.int32), *operands)
     return out.reshape(b, 1, h, d)
 
 

@@ -1,0 +1,368 @@
+"""In-library pod streaming: WebSocket codec, exec channels, port-forward,
+pod logs, and kubeconfig auth resolution — against in-process stubs, the
+same fake-the-data-plane strategy the reference's envtest suite uses
+(SURVEY.md §4).
+"""
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from substratus_tpu.kube.ws import ExecStream, PortForwardStream, WebSocket
+
+MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+# ---------------------------------------------------------------- stub side
+
+
+def _server_recv_frame(conn):
+    """Server-side frame reader (expects masked client frames)."""
+    head = _read_exact(conn, 2)
+    if head is None:
+        return None, None
+    b1, b2 = head
+    opcode = b1 & 0x0F
+    n = b2 & 0x7F
+    if n == 126:
+        (n,) = struct.unpack(">H", _read_exact(conn, 2))
+    elif n == 127:
+        (n,) = struct.unpack(">Q", _read_exact(conn, 8))
+    mask = _read_exact(conn, 4) if b2 & 0x80 else b""
+    payload = _read_exact(conn, n) if n else b""
+    if mask and payload:
+        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
+
+
+def _read_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None if not buf else buf
+        buf += chunk
+    return buf
+
+
+def _server_send(conn, payload, opcode=0x2):
+    n = len(payload)
+    head = bytes([0x80 | opcode])
+    if n < 126:
+        head += bytes([n])
+    elif n < 65536:
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    conn.sendall(head + payload)
+
+
+def _upgrade(conn):
+    """Read the HTTP upgrade request, reply 101. Returns request line."""
+    req = b""
+    while b"\r\n\r\n" not in req:
+        req += conn.recv(4096)
+    request_line = req.split(b"\r\n", 1)[0].decode()
+    key = ""
+    for line in req.split(b"\r\n"):
+        if line.lower().startswith(b"sec-websocket-key:"):
+            key = line.split(b":", 1)[1].strip().decode()
+    accept = base64.b64encode(
+        hashlib.sha1((key + MAGIC).encode()).digest()
+    ).decode()
+    conn.sendall(
+        (
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept}\r\n\r\n"
+        ).encode()
+    )
+    return request_line
+
+
+class StubWSServer:
+    """One-shot WebSocket server running `handler(conn, request_line)`."""
+
+    def __init__(self, handler):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.handler = handler
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._one, args=(conn,), daemon=True
+            ).start()
+
+    def _one(self, conn):
+        try:
+            line = _upgrade(conn)
+            self.handler(conn, line)
+        finally:
+            conn.close()
+
+    def close(self):
+        self.sock.close()
+
+
+# ------------------------------------------------------------------- tests
+
+
+def test_ws_roundtrip_including_large_and_fragmented_frames():
+    got = []
+
+    def handler(conn, line):
+        # Echo two messages back (one large -> extended length), then a
+        # fragmented message, then close.
+        for _ in range(2):
+            op, payload = _server_recv_frame(conn)
+            got.append(payload)
+            _server_send(conn, payload)
+        # fragmented: "frag" + "ment" as two frames (fin=0 then fin=1)
+        conn.sendall(bytes([0x02, 4]) + b"frag")
+        conn.sendall(bytes([0x80, 4]) + b"ment")
+        _server_send(conn, b"", opcode=0x8)
+
+    srv = StubWSServer(handler)
+    ws = WebSocket.connect(f"http://127.0.0.1:{srv.port}/echo")
+    small = b"hello"
+    big = os.urandom(70000)  # forces the 8-byte extended length
+    ws.send(small)
+    ws.send(big)
+    assert ws.recv() == small
+    assert ws.recv() == big
+    assert ws.recv() == b"fragment"
+    assert ws.recv() is None  # close
+    assert got == [small, big]
+    srv.close()
+
+
+def test_exec_stream_channels_and_status():
+    def handler(conn, line):
+        assert "command=nbwatch" in line
+        _server_send(conn, b"\x01out1")   # stdout
+        _server_send(conn, b"\x02oops")   # stderr
+        _server_send(conn, b"\x01out2")
+        _server_send(
+            conn,
+            b"\x03" + json.dumps({"status": "Success"}).encode(),
+        )
+        _server_send(conn, b"", opcode=0x8)
+
+    srv = StubWSServer(handler)
+    ws = WebSocket.connect(
+        f"http://127.0.0.1:{srv.port}/api/v1/namespaces/d/pods/p/exec"
+        "?stdout=1&command=nbwatch",
+        subprotocols=("v4.channel.k8s.io",),
+    )
+    out, err, status = ExecStream(ws).run()
+    assert out == b"out1out2"
+    assert err == b"oops"
+    assert status["status"] == "Success"
+    srv.close()
+
+
+def test_exec_stdin_reaches_server():
+    received = {}
+
+    def handler(conn, line):
+        op, payload = _server_recv_frame(conn)
+        received["msg"] = payload
+        _server_send(conn, b"\x01ack")
+        _server_send(conn, b"", opcode=0x8)
+
+    srv = StubWSServer(handler)
+    ws = WebSocket.connect(
+        f"http://127.0.0.1:{srv.port}/exec",
+        subprotocols=("v4.channel.k8s.io",),
+    )
+    stream = ExecStream(ws)
+    stream.send_stdin(b"payload")
+    out, _, _ = stream.run()
+    assert received["msg"] == b"\x00payload"  # stdin channel byte
+    assert out == b"ack"
+    srv.close()
+
+
+def test_port_forward_stream_skips_announcements_and_pumps_data():
+    def handler(conn, line):
+        assert "ports=9000" in line
+        _server_send(conn, b"\x00" + struct.pack("<H", 9000))  # data announce
+        _server_send(conn, b"\x01" + struct.pack("<H", 9000))  # error announce
+        op, payload = _server_recv_frame(conn)  # client -> remote data
+        _server_send(conn, b"\x00RE:" + payload[1:])
+        _server_send(conn, b"", opcode=0x8)
+
+    srv = StubWSServer(handler)
+    ws = WebSocket.connect(
+        f"http://127.0.0.1:{srv.port}/portforward?ports=9000",
+        subprotocols=("portforward.k8s.io",),
+    )
+    stream = PortForwardStream(ws)
+    stream.send(b"ping")
+    chunks = list(stream.chunks())
+    assert chunks == [b"RE:ping"]
+    srv.close()
+
+
+def test_real_kube_port_forward_end_to_end():
+    """RealKube.port_forward: local TCP socket -> stub apiserver WS."""
+    from substratus_tpu.kube.real import RealKube
+
+    def handler(conn, line):
+        _server_send(conn, b"\x00" + struct.pack("<H", 8080))
+        _server_send(conn, b"\x01" + struct.pack("<H", 8080))
+        op, payload = _server_recv_frame(conn)
+        _server_send(conn, b"\x00echo:" + payload[1:])
+        # Keep the stream open until the client closes.
+        while True:
+            op, _ = _server_recv_frame(conn)
+            if op in (None, 0x8):
+                return
+
+    srv = StubWSServer(handler)
+    client = RealKube(f"http://127.0.0.1:{srv.port}")
+    stop = threading.Event()
+    ready = threading.Event()
+    local_port = _free_port()
+    t = threading.Thread(
+        target=client.port_forward,
+        args=("default", "pod-x", local_port, 8080),
+        kwargs={"stop": stop, "ready": ready},
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(5.0)
+    with socket.create_connection(("127.0.0.1", local_port), 5.0) as conn:
+        conn.sendall(b"hello")
+        conn.settimeout(5.0)
+        assert conn.recv(100) == b"echo:hello"
+    stop.set()
+    t.join(5.0)
+    srv.close()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_kubeconfig_client_cert_and_exec_plugin(tmp_path):
+    from substratus_tpu.kube.config import client_from_kubeconfig
+
+    # A fake credential plugin that emits an ExecCredential token.
+    plugin = tmp_path / "fake-auth.sh"
+    plugin.write_text(
+        "#!/bin/sh\n"
+        'echo \'{"apiVersion": "client.authentication.k8s.io/v1beta1",'
+        ' "kind": "ExecCredential",'
+        ' "status": {"token": "exec-plugin-token"}}\'\n'
+    )
+    plugin.chmod(0o755)
+
+    cert_pem, key_pem = _self_signed_pair(tmp_path)
+    kc = {
+        "current-context": "exec-ctx",
+        "contexts": [
+            {"name": "exec-ctx",
+             "context": {"cluster": "c1", "user": "exec-user"}},
+            {"name": "cert-ctx",
+             "context": {"cluster": "c1", "user": "cert-user"}},
+            {"name": "token-ctx",
+             "context": {"cluster": "c1", "user": "token-user"}},
+        ],
+        "clusters": [
+            {"name": "c1", "cluster": {
+                "server": "https://example:6443",
+                "insecure-skip-tls-verify": True,
+            }},
+        ],
+        "users": [
+            {"name": "exec-user", "user": {"exec": {
+                "apiVersion": "client.authentication.k8s.io/v1beta1",
+                "command": str(plugin),
+            }}},
+            {"name": "cert-user", "user": {
+                "client-certificate-data": base64.b64encode(
+                    cert_pem.encode()).decode(),
+                "client-key-data": base64.b64encode(
+                    key_pem.encode()).decode(),
+            }},
+            {"name": "token-user", "user": {"token": "static-token"}},
+        ],
+    }
+    import yaml
+
+    path = tmp_path / "config"
+    path.write_text(yaml.safe_dump(kc))
+
+    c = client_from_kubeconfig(str(path))  # current-context -> exec plugin
+    assert c.token == "exec-plugin-token"
+
+    c = client_from_kubeconfig(str(path), context="token-ctx")
+    assert c.token == "static-token"
+
+    c = client_from_kubeconfig(str(path), context="cert-ctx")
+    assert c.token is None  # authenticated by the loaded client cert
+
+
+def test_pod_logs_streams_lines():
+    import http.server
+
+    from substratus_tpu.kube.real import RealKube
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            assert "/pods/my-pod/log" in self.path
+            assert "tailLines=5" in self.path
+            body = b"line one\nline two\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = RealKube(f"http://127.0.0.1:{httpd.server_port}")
+    lines = list(client.pod_logs("default", "my-pod", tail=5))
+    assert lines == ["line one", "line two"]
+    httpd.shutdown()
+
+
+def _self_signed_pair(tmp_path):
+    """Throwaway self-signed cert/key (only exercises load_cert_chain)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl not available")
+    cert = tmp_path / "c.crt"
+    key = tmp_path / "c.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "ec",
+         "-pkeyopt", "ec_paramgen_curve:prime256v1",
+         "-keyout", str(key), "-out", str(cert),
+         "-days", "1", "-nodes", "-subj", "/CN=test"],
+        check=True, capture_output=True,
+    )
+    return cert.read_text(), key.read_text()

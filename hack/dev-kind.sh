@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Dev loop against a kind cluster (reference: skaffold.kind.yaml:1-36 —
+# rebuild the manager image on change, push to the in-cluster registry,
+# restart the Deployments).
+#
+#   hack/dev-kind.sh          # one build-push-restart cycle
+#   hack/dev-kind.sh --watch  # re-run the cycle whenever sources change
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REGISTRY=${REGISTRY:-localhost:5000}
+IMAGE="$REGISTRY/substratus-tpu/runtime:dev"
+
+cycle() {
+  docker build -t "$IMAGE" .
+  docker push "$IMAGE"
+  kubectl set image -n substratus deployment/controller-manager "manager=$IMAGE"
+  kubectl set image -n substratus deployment/sci "sci=$IMAGE"
+  kubectl rollout restart -n substratus deployment/controller-manager deployment/sci
+  kubectl rollout status -n substratus deployment/controller-manager --timeout=120s
+}
+
+cycle
+[ "${1:-}" = "--watch" ] || exit 0
+
+echo "watching substratus_tpu/ for changes..."
+last=$(find substratus_tpu native Dockerfile -type f -newer /dev/null -exec stat -c %Y {} + | sort -n | tail -1)
+while sleep 2; do
+  now=$(find substratus_tpu native Dockerfile -type f -exec stat -c %Y {} + | sort -n | tail -1)
+  if [ "$now" != "$last" ]; then
+    last=$now
+    echo "change detected; rebuilding"
+    cycle || echo "cycle failed; will retry on next change"
+  fi
+done

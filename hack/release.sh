@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Release artifact builder (reference: .goreleaser.yaml:22-45 builds `sub`
+# platform binaries + a `container-tools` archive with nbwatch).
+#
+# Python equivalent: a self-contained `sub` zipapp (runs anywhere with a
+# python3 interpreter — the moral analogue of a static binary), the
+# compiled nbwatch container tool, and sha256 checksums.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VERSION=${VERSION:-$(git describe --tags --always --dirty 2>/dev/null || echo dev)}
+OUT=dist
+rm -rf "$OUT" && mkdir -p "$OUT/stage"
+
+# 1. sub CLI zipapp
+cp -r substratus_tpu "$OUT/stage/"
+find "$OUT/stage" -name __pycache__ -type d -exec rm -rf {} +
+cat > "$OUT/stage/__main__.py" <<'EOF'
+from substratus_tpu.cli.main import main
+import sys
+sys.exit(main())
+EOF
+python3 -m zipapp "$OUT/stage" -o "$OUT/sub-$VERSION.pyz" -p "/usr/bin/env python3"
+rm -rf "$OUT/stage"
+
+# 2. container-tools archive (nbwatch; reference goreleaser "container-tools")
+make nbwatch
+tar -czf "$OUT/container-tools-$VERSION-linux-$(uname -m).tar.gz" -C native nbwatch
+
+# 3. installation manifest + checksums
+make install-manifests >/dev/null
+cp install/substratus-tpu.yaml "$OUT/substratus-tpu-$VERSION.yaml"
+(cd "$OUT" && sha256sum ./* > "checksums-$VERSION.txt")
+
+echo "release artifacts in $OUT/:"
+ls -lh "$OUT"

@@ -18,10 +18,19 @@ nodes:
     extraPortMappings:
       - containerPort: 30080
         hostPort: 30080
+      - containerPort: 30500
+        hostPort: 5000
+containerdConfigPatches:
+  # Trust the in-cluster registry (config/registry-kind/registry.yaml) over
+  # plain HTTP; localhost:5000 resolves to its NodePort on every node.
+  - |-
+    [plugins."io.containerd.grpc.v1.cri".registry.mirrors."localhost:5000"]
+      endpoint = ["http://localhost:30500"]
 EOF
 
 make install-manifests
 kubectl apply -f install/substratus-tpu.yaml
+kubectl apply -f config/registry-kind/registry.yaml
 kubectl create configmap system -n substratus \
   --from-literal=CLOUD=local \
   --from-literal=CLUSTER_NAME="$CLUSTER" \

@@ -384,8 +384,9 @@ def cmd_notebook(args) -> int:
 
 def cmd_logs(args) -> int:
     """Logs for the workload a CR owns (reference: the TUI's pods panel,
-    internal/tui — pod list/log streaming). Real clusters shell out to
-    kubectl; the fake cluster prints the workload object's status."""
+    internal/tui — pod list/log streaming). Real clusters stream via
+    client.pod_logs (REST, follow); the fake cluster prints the workload
+    object's status."""
     client = _client(args)
     kind = _norm_kind(args.kind)
     if args.fake and _FAKE_ENV is not None:

@@ -52,6 +52,11 @@ def main(argv=None) -> int:
     ap.add_argument("--cloud", default=None)
     ap.add_argument("--probe-port", type=int, default=8081)
     ap.add_argument(
+        "--metrics-port", type=int, default=8443,
+        help="RBAC-protected HTTPS /metrics (kube-rbac-proxy equivalent, "
+        "in-process); 0 disables the protected listener",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="in-memory apiserver + fake SCI (local development)",
     )
@@ -80,7 +85,19 @@ def main(argv=None) -> int:
     # a warm standby.
     from substratus_tpu.observability.health import serve_health
 
-    serve_health(port=args.probe_port, manager=None)
+    protect = bool(args.metrics_port) and not args.fake
+    # When the protected listener owns /metrics, the open probe port must
+    # not also serve it (that would bypass the RBAC check entirely).
+    serve_health(
+        port=args.probe_port, manager=None, expose_metrics=not protect
+    )
+    if protect:
+        from substratus_tpu.observability.authz import MetricsAuthorizer
+
+        serve_health(
+            port=args.metrics_port, manager=None,
+            authorizer=MetricsAuthorizer(client), tls=True,
+        )
 
     if args.leader_elect and not args.fake:
         from substratus_tpu.controller.leader import LeaderElector

@@ -11,6 +11,7 @@ Resolution order per kubeconfig `user`:
 """
 from __future__ import annotations
 
+import atexit
 import base64
 import json
 import os
@@ -26,10 +27,21 @@ SA_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
 
 
 def _write_tmp(content: str, suffix: str) -> str:
-    tmp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False, mode="w")
-    tmp.write(content)
-    tmp.close()
-    return tmp.name
+    """Secret material (client keys, exec-plugin certs) decoded to disk for
+    ssl.load_cert_chain, which only takes paths. Mode 0600 via mkstemp and
+    unlinked at interpreter exit — keys must not outlive the CLI run."""
+    fd, path = tempfile.mkstemp(suffix=suffix)
+    with os.fdopen(fd, "w") as f:
+        f.write(content)
+    atexit.register(_unlink_quiet, path)
+    return path
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
 
 
 def _materialize(data_b64: Optional[str], path: Optional[str],

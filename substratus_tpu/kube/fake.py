@@ -26,6 +26,27 @@ class FakeKube(KubeClient):
         self._uid = 0
         self._listeners: List[Callable[[str, Obj], None]] = []
         self._lock = threading.RLock()
+        # Auth tables for the create-only review APIs (metrics RBAC tests):
+        # token -> {"username": ..., "groups": [...]}; users allowed to GET
+        # non-resource URLs like /metrics.
+        self.tokens: Dict[str, Dict[str, Any]] = {}
+        self.metrics_readers: set = set()
+
+    def _review(self, obj: Obj) -> Obj:
+        """Evaluate TokenReview / SubjectAccessReview like the apiserver
+        (authentication/authorization.k8s.io are create-only, unstored)."""
+        obj = copy.deepcopy(obj)
+        spec = obj.get("spec", {})
+        if obj["kind"] == "TokenReview":
+            user = self.tokens.get(spec.get("token", ""))
+            obj["status"] = (
+                {"authenticated": True, "user": dict(user)}
+                if user else {"authenticated": False}
+            )
+        else:
+            allowed = spec.get("user") in self.metrics_readers
+            obj["status"] = {"allowed": allowed}
+        return obj
 
     # -- helpers -----------------------------------------------------------
 
@@ -58,6 +79,9 @@ class FakeKube(KubeClient):
             ]
 
     def create(self, obj: Obj) -> Obj:
+        if obj.get("kind") in ("TokenReview", "SubjectAccessReview"):
+            with self._lock:
+                return self._review(obj)
         with self._lock:
             obj = copy.deepcopy(obj)
             md = obj.setdefault("metadata", {})

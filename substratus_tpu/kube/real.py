@@ -13,6 +13,7 @@ kubeconfig via kube/config.py (tokens, client certs, exec plugins).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import socket
 import ssl
@@ -46,13 +47,24 @@ RESOURCE_MAP: Dict[str, tuple] = {
     "Deployment": ("/apis/apps/v1", "deployments"),
     "JobSet": ("/apis/jobset.x-k8s.io/v1alpha2", "jobsets"),
     "Lease": ("/apis/coordination.k8s.io/v1", "leases"),
+    # Cluster-scoped, create-only review APIs (metrics RBAC —
+    # observability/authz.py; kube-rbac-proxy parity).
+    "TokenReview": ("/apis/authentication.k8s.io/v1", "tokenreviews"),
+    "SubjectAccessReview": (
+        "/apis/authorization.k8s.io/v1", "subjectaccessreviews"
+    ),
 }
+
+# Kinds with no namespace segment in their URL (and no watch support).
+CLUSTER_SCOPED = ("TokenReview", "SubjectAccessReview")
 
 # Kinds the controller watches. Lease is deliberately excluded: the elector
 # only gets/updates one Lease, and a cluster-wide Lease watch would stream
 # every node heartbeat and kube-system leader renewal into the workqueue
 # (and typically 403 under the manager's RBAC anyway).
-WATCHED_KINDS = tuple(k for k in RESOURCE_MAP if k != "Lease")
+WATCHED_KINDS = tuple(
+    k for k in RESOURCE_MAP if k != "Lease" and k not in CLUSTER_SCOPED
+)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -140,6 +152,10 @@ class RealKube(KubeClient):
         return items
 
     def create(self, obj: Obj) -> Obj:
+        if obj["kind"] in CLUSTER_SCOPED:
+            return self._request(
+                "POST", self._path(obj["kind"], None), obj
+            )
         md = obj["metadata"]
         return self._request(
             "POST", self._path(obj["kind"], md.get("namespace", "default")), obj
@@ -339,11 +355,14 @@ class RealKube(KubeClient):
         """Upload one file. `head -c N > path` consumes exactly the payload
         size, so completion needs no stdin-EOF signal (the v4 channel
         protocol has none)."""
+        import shlex
+
         with open(local_path, "rb") as f:
             data = f.read()
         rc, _, err = self.pod_exec(
             namespace, pod,
-            ["sh", "-c", f"head -c {len(data)} > {remote_path}"],
+            ["sh", "-c",
+             f"head -c {len(data)} > {shlex.quote(remote_path)}"],
             stdin_data=data,
         )
         return rc == 0
@@ -371,8 +390,19 @@ class RealKube(KubeClient):
         listener.settimeout(0.5)
         if ready is not None:
             ready.set()
+        # Consecutive WS dial failures poison the forward: raising from
+        # here (instead of silently eating them in connection threads)
+        # reaches cli/sync.py's retry/backoff exactly like a dead kubectl
+        # subprocess did.
+        self._pf_dial_failures = 0
+        self._pf_last_error: Optional[Exception] = None
         try:
             while not (stop is not None and stop.is_set()):
+                if self._pf_dial_failures >= 3:
+                    raise KubeError(
+                        f"port-forward to {namespace}/{pod}:{remote_port} "
+                        f"failing: {self._pf_last_error}"
+                    )
                 try:
                     conn, _ = listener.accept()
                 except socket.timeout:
@@ -388,23 +418,34 @@ class RealKube(KubeClient):
     def _forward_one(self, namespace, pod, remote_port, conn, stop) -> None:
         from substratus_tpu.kube.ws import PortForwardStream
 
+        log = logging.getLogger(__name__)
         try:
             ws = self._ws_connect(
                 self._path("Pod", namespace, pod, "portforward"),
                 urllib.parse.urlencode([("ports", str(remote_port))]),
                 ("portforward.k8s.io",),
             )
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — surfaced via the accept loop
+            self._pf_dial_failures = getattr(self, "_pf_dial_failures", 0) + 1
+            self._pf_last_error = e
+            log.warning("port-forward dial %s/%s:%s failed: %s",
+                        namespace, pod, remote_port, e)
             conn.close()
             return
+        self._pf_dial_failures = 0
         stream = PortForwardStream(ws)
 
         def pump_out():
             try:
                 for chunk in stream.chunks():
                     conn.sendall(chunk)
-            except Exception:
-                pass
+            except OSError:
+                pass  # local browser/tool hung up; routine
+            except Exception as e:  # noqa: BLE001
+                # WSError from the error channel: pod-side failure worth
+                # telling the user about (kubectl printed these too).
+                log.warning("port-forward stream %s/%s:%s: %s",
+                            namespace, pod, remote_port, e)
             finally:
                 try:
                     conn.shutdown(socket.SHUT_WR)

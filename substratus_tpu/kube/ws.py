@@ -25,6 +25,7 @@ import os
 import socket
 import ssl
 import struct
+import threading
 from typing import Iterator, Optional, Tuple
 from urllib.parse import urlsplit
 
@@ -38,13 +39,30 @@ class WSError(RuntimeError):
     pass
 
 
+def _mask_xor(payload: bytes, mask: bytes) -> bytes:
+    """XOR `payload` with the repeating 4-byte mask, in bulk (one bignum
+    XOR, ~GB/s) — per-byte Python loops cap exec/port-forward throughput
+    at a few MB/s."""
+    n = len(payload)
+    if n == 0:
+        return b""
+    reps = mask * ((n + 3) // 4)
+    x = int.from_bytes(payload, "little") ^ int.from_bytes(reps[:n], "little")
+    return x.to_bytes(n, "little")
+
+
 class WebSocket:
     """One client WebSocket connection (blocking I/O)."""
 
     def __init__(self, sock: socket.socket):
         self._sock = sock
-        self._buf = b""
+        self._buf = bytearray()
         self.closed = False
+        # One frame at a time on the wire: recv() replies to PING/CLOSE
+        # from whatever thread is reading while another thread sends data
+        # (port-forward does exactly this); interleaved sendall bytes would
+        # desync the server's frame parser.
+        self._send_lock = threading.Lock()
 
     # -- connection -------------------------------------------------------
 
@@ -103,7 +121,7 @@ class WebSocket:
         # legitimately idle far longer than any fixed timeout.
         raw.settimeout(None)
         ws = cls(raw)
-        ws._buf = rest
+        ws._buf = bytearray(rest)
         return ws
 
     # -- frames -----------------------------------------------------------
@@ -113,8 +131,9 @@ class WebSocket:
             chunk = self._sock.recv(65536)
             if not chunk:
                 raise WSError("connection closed mid-frame")
-            self._buf += chunk
-        out, self._buf = self._buf[:n], self._buf[n:]
+            self._buf += chunk  # bytearray: amortized append, no re-copy
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
         return out
 
     def send(self, payload: bytes, opcode: int = _OP_BINARY) -> None:
@@ -128,8 +147,9 @@ class WebSocket:
             head += bytes([0x80 | 126]) + struct.pack(">H", n)
         else:
             head += bytes([0x80 | 127]) + struct.pack(">Q", n)
-        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
-        self._sock.sendall(head + mask + masked)
+        masked = _mask_xor(payload, mask)
+        with self._send_lock:
+            self._sock.sendall(head + mask + masked)
 
     def recv(self) -> Optional[bytes]:
         """Next complete message payload; None once the peer closes.
@@ -146,9 +166,7 @@ class WebSocket:
             mask = self._read_exact(4) if masked else b""
             payload = self._read_exact(n)
             if mask:
-                payload = bytes(
-                    b ^ mask[i % 4] for i, b in enumerate(payload)
-                )
+                payload = _mask_xor(payload, mask)
             if opcode == _OP_PING:
                 self.send(payload, _OP_PONG)
                 continue

@@ -4,11 +4,17 @@ Reference parity: controller-runtime serves /healthz,/readyz (main.go:227-234)
 and Prometheus metrics behind kube-rbac-proxy (SURVEY.md §5). Here a single
 stdlib HTTP endpoint serves both; metrics are text-format counters the
 Manager updates (reconcile totals/errors/queue depth) — scrape-compatible
-without a client library.
+without a client library. Passing an authorizer (observability/authz.py)
+RBAC-protects /metrics exactly as the reference's kube-rbac-proxy sidecar
+does; `tls=True` serves HTTPS with a self-signed cert (the ServiceMonitor
+scrapes with insecureSkipVerify, reference config/prometheus/monitor.yaml).
 """
 from __future__ import annotations
 
 import http.server
+import logging
+import ssl
+import tempfile
 import threading
 from typing import Optional
 
@@ -43,14 +49,33 @@ METRICS = Metrics()
 
 
 def serve_health(
-    port: int = 8081, manager=None, block: bool = False
+    port: int = 8081, manager=None, block: bool = False,
+    authorizer=None, tls: bool = False, expose_metrics: bool = True,
 ) -> http.server.ThreadingHTTPServer:
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
             if self.path in ("/healthz", "/readyz"):
                 body = b"ok"
                 self.send_response(200)
+            elif self.path == "/metrics" and not expose_metrics:
+                # A protected listener owns /metrics; serving it here too
+                # would let anyone bypass the RBAC check via the probe port.
+                body = b"metrics are served on the authenticated port"
+                self.send_response(403)
             elif self.path == "/metrics":
+                if authorizer is not None:
+                    status, reason = authorizer.allow(
+                        self.headers.get("Authorization")
+                    )
+                    if status != 200:
+                        body = reason.encode()
+                        self.send_response(status)
+                        if status == 401:
+                            self.send_header("WWW-Authenticate", "Bearer")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                 if manager is not None:
                     with manager._lock:
                         METRICS.set(
@@ -69,9 +94,98 @@ def serve_health(
         def log_message(self, *a):  # quiet
             pass
 
-    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    if tls:
+        ctx = _tls_context()
+        if ctx is None:
+            logging.getLogger(__name__).warning(
+                "no TLS backend (cryptography/openssl); metrics port "
+                "serving PLAIN HTTP — bearer tokens cross the wire unencrypted"
+            )
+
+        class Server(http.server.ThreadingHTTPServer):
+            # Handshake runs in the per-connection thread (finish_request),
+            # never in the accept loop: a client that connects and stalls
+            # must not wedge the listener for every later scrape.
+            def finish_request(self, request, client_address):
+                if ctx is not None:
+                    request.settimeout(10)
+                    request = ctx.wrap_socket(request, server_side=True)
+                self.RequestHandlerClass(request, client_address, self)
+
+            def handle_error(self, request, client_address):
+                pass  # handshake garbage from scanners is routine
+
+        server = Server(("0.0.0.0", port), Handler)
+    else:
+        server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
     if block:
         server.serve_forever()
     else:
         threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
+
+
+def _tls_context() -> Optional[ssl.SSLContext]:
+    """TLS context with an ephemeral self-signed cert (the scraper uses
+    insecureSkipVerify; TLS here is for token confidentiality on the wire,
+    matching kube-rbac-proxy's --secure-listen-address). Cert generation
+    prefers the `cryptography` package, falls back to the openssl binary,
+    and returns None when neither exists (caller logs and serves HTTP)."""
+    pem = _selfsigned_pem()
+    if pem is None:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    with tempfile.NamedTemporaryFile(suffix=".pem") as f:
+        f.write(pem)
+        f.flush()
+        ctx.load_cert_chain(f.name)
+    return ctx
+
+
+def _selfsigned_pem() -> Optional[bytes]:
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+        import datetime
+
+        key = ec.generate_private_key(ec.SECP256R1())
+        name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "substratus-metrics")]
+        )
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .sign(key, hashes.SHA256())
+        )
+        return key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ) + cert.public_bytes(serialization.Encoding.PEM)
+    except ImportError:
+        pass
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        return None
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "ec",
+                 "-pkeyopt", "ec_paramgen_curve:prime256v1", "-nodes",
+                 "-keyout", f"{d}/key.pem", "-out", f"{d}/cert.pem",
+                 "-days", "3650", "-subj", "/CN=substratus-metrics"],
+                check=True, capture_output=True, timeout=30,
+            )
+        except (subprocess.SubprocessError, OSError):
+            return None
+        with open(f"{d}/key.pem", "rb") as kf, open(f"{d}/cert.pem", "rb") as cf:
+            return kf.read() + cf.read()

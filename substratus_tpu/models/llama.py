@@ -28,7 +28,7 @@ from jax import lax
 
 from substratus_tpu.ops.attention import dot_product_attention
 from substratus_tpu.ops.basics import rms_norm, rope, swiglu, lora_delta
-from substratus_tpu.ops.quant import materialize
+from substratus_tpu.ops.quant import materialize, qeinsum
 
 Params = Dict[str, Any]
 
@@ -151,7 +151,15 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
 
 
 def quant_contracting(cfg: LlamaConfig) -> Params:
-    """Contracting dims per leaf for ops.quant.quantize_params; () = dense."""
+    """Contracting dims per leaf for ops.quant.quantize_params; () = dense.
+
+    Axes are for the STACKED layer leaves (leading layer dim from
+    init_params), e.g. wq [L, d, h, k] contracts d=1. The resulting scales
+    are per-output-channel — the standard quality choice, and what lets
+    qeinsum commute the scale out of the dot after lax.scan slices the
+    layer dim off (scale-after-dot keeps the int8 bytes on the MXU operand
+    path; see ops/quant.py).
+    """
     moe = cfg.n_experts > 0
     layers = {
         "attn_norm": (),
@@ -344,7 +352,7 @@ def _moe_ffn(
 
     def eproj(name, x, eq_w, eq_a, eq_b):
         """Per-expert projection with optional expert-routed LoRA delta."""
-        out = jnp.einsum(eq_w, x, materialize(lp[name], dt))
+        out = qeinsum(eq_w, x, lp[name], dt)
         if name in lora:
             down = jnp.einsum(eq_a, x, lora[name]["a"].astype(dt))
             out = out + jnp.einsum(
@@ -432,7 +440,7 @@ def _block(
     lora = lora_layers or {}
 
     def proj(name: str, inp: jnp.ndarray, eq: str, lora_eq: str) -> jnp.ndarray:
-        out = jnp.einsum(eq, inp, materialize(lp[name], dt))
+        out = qeinsum(eq, inp, lp[name], dt)
         if name in lora:
             out = out + lora_delta(inp, lora[name], lora_scale, lora_eq)
         return out
@@ -467,7 +475,7 @@ def _block(
 
     b, s = x.shape[:2]
     attn_flat = attn.reshape(b, s, -1)
-    o = jnp.einsum("bshk,hkd->bsd", attn, materialize(lp["wo"], dt))
+    o = qeinsum("bshk,hkd->bsd", attn, lp["wo"], dt)
     if "wo" in lora:
         o = o + lora_delta(attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd")
     x = x + o
@@ -545,7 +553,7 @@ def forward(
             "bsd,vd->bsv", x, materialize(params["tok_embed"], cfg.dtype)
         )
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, materialize(params["lm_head"], cfg.dtype))
+        logits = qeinsum("bsd,dv->bsv", x, params["lm_head"], cfg.dtype)
     kv = ys["kv"]  # stacked over layers; same structure as the cache
     if cfg.n_experts > 0 and cache is None:
         # Per-layer router load-balancing losses (training/prefill only —

@@ -1,0 +1,107 @@
+"""Round 2 probe: model-shaped weight streaming (unrolled chain of distinct
+weight arrays, like the real layer stack) bf16 vs int8-dequant vs
+int8-MXU(scale-after-dot), plus a read-only bandwidth ceiling."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+B, D, F, L = 16, 4096, 11008, 16
+
+
+def sync(x):
+    jnp.ravel(jax.tree.leaves(x)[0])[0].item()
+
+
+def timeit1(fn, *args, n=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    key = jax.random.key(0)
+
+    # Read-only ceiling: 2GB reduction per iteration.
+    big = jax.random.normal(key, (8, 1024, 131072), jnp.bfloat16)  # 2GB
+
+    @jax.jit
+    def read_only(x, s0):
+        def step(s, xi):
+            return s + jnp.sum(xi, dtype=jnp.float32), ()
+        s, _ = jax.lax.scan(step, s0, x)
+        return s
+
+    t = timeit1(read_only, big, jnp.float32(0))
+    print(f"read-only: {t*1e3:8.2f}ms  {big.size*2/t/1e9:6.0f} GB/s")
+
+    keys = jax.random.split(key, L)
+    wbf = [jax.random.normal(k, (D, F), jnp.bfloat16) for k in keys]
+    wq = [jax.random.randint(k, (D, F), -127, 128, jnp.int8) for k in keys]
+    scales = [jnp.full((1, F), 0.01, jnp.float32) for _ in keys]
+    x = jax.random.normal(key, (B, D), jnp.bfloat16)
+
+    @jax.jit
+    def chain_bf16(x, *ws):
+        for w in ws:
+            x = jnp.tanh((x @ w)[:, :D])
+        return x
+
+    @jax.jit
+    def chain_deq(x, *wss):
+        ws, ss = wss[:L], wss[L:]
+        for w, s in zip(ws, ss):
+            wd = (w.astype(jnp.float32) * s).astype(jnp.bfloat16)
+            x = jnp.tanh((x @ wd)[:, :D])
+        return x
+
+    @jax.jit
+    def chain_mxu(x, *wss):
+        ws, ss = wss[:L], wss[L:]
+        for w, s in zip(ws, ss):
+            y = jax.lax.dot_general(
+                x, w.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * s
+            x = jnp.tanh(y[:, :D]).astype(jnp.bfloat16)
+        return x
+
+    @jax.jit
+    def chain_w8a8(x, *wss):
+        ws, ss = wss[:L], wss[L:]
+        for w, s in zip(ws, ss):
+            # dynamic per-token activation quant -> int8 MXU dot
+            amax = jnp.max(jnp.abs(x), axis=1, keepdims=True).astype(jnp.float32)
+            ascale = jnp.where(amax == 0, 1.0, amax / 127.0)
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / ascale), -127, 127
+                          ).astype(jnp.int8)
+            y = jax.lax.dot_general(
+                xq, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * (s * ascale)
+            x = jnp.tanh(y[:, :D]).astype(jnp.bfloat16)
+        return x
+
+    gb_bf = L * D * F * 2 / 1e9
+    gb_i8 = L * D * F / 1e9
+    t_bf = timeit1(chain_bf16, x, *wbf)
+    print(f"chain bf16: {t_bf*1e3:8.2f}ms  {gb_bf/t_bf:6.0f} GB/s")
+    t_dq = timeit1(chain_deq, x, *wq, *scales)
+    print(f"chain int8 dequant:   {t_dq*1e3:8.2f}ms  {gb_i8/t_dq:6.0f} GB/s(int8)  {t_bf/t_dq:4.2f}x vs bf16")
+    t_mx = timeit1(chain_mxu, x, *wq, *scales)
+    print(f"chain int8 scale-after: {t_mx*1e3:8.2f}ms  {gb_i8/t_mx:6.0f} GB/s(int8)  {t_bf/t_mx:4.2f}x vs bf16")
+    t_88 = timeit1(chain_w8a8, x, *wq, *scales)
+    print(f"chain w8a8 MXU:       {t_88*1e3:8.2f}ms  {gb_i8/t_88:6.0f} GB/s(int8)  {t_bf/t_88:4.2f}x vs bf16")
+
+
+if __name__ == "__main__":
+    main()

@@ -173,6 +173,7 @@ def run_measurement(
     steps: int = 128,
     config: str = "llama2-7b",
     kv_dtype: str = "int8",
+    w8a8: bool = False,
 ) -> None:
     """The measured bench body. Runs in the watchdog child; prints the JSON
     line on success, raises on failure."""
@@ -182,6 +183,8 @@ def run_measurement(
     from substratus_tpu.models import llama
 
     cfg = llama.CONFIGS[config]
+    if w8a8:
+        cfg = cfg.replace(quant_activations=True)
     params = jax.jit(
         lambda k: random_quantized_params(cfg, k)
     )(jax.random.key(0))
@@ -299,11 +302,12 @@ def probe_backend(timeout_s: float = 90.0, attempts: int = 3) -> str | None:
     return last
 
 
-def child_argv(batch, cache_len, steps, config, kv_dtype):
+def child_argv(batch, cache_len, steps, config, kv_dtype, w8a8):
     return [
         sys.executable, os.path.abspath(__file__), "--child",
         "--batch", str(batch), "--cache-len", str(cache_len),
         "--steps", str(steps), "--config", config, "--kv-dtype", kv_dtype,
+        *(["--w8a8"] if w8a8 else []),
     ]
 
 
@@ -316,6 +320,10 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--config", default="llama2-7b")  # validated below
     ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
+    ap.add_argument(
+        "--w8a8", action="store_true",
+        help="dynamic int8 activation quant (s8xs8 MXU matmuls)",
+    )
     ap.add_argument(
         "--no-fallback", action="store_true",
         help="fail instead of retrying smaller tiers",
@@ -332,7 +340,8 @@ def main() -> int:
     a = ap.parse_args()
 
     if a.child:
-        run_measurement(a.batch, a.cache_len, a.steps, a.config, a.kv_dtype)
+        run_measurement(a.batch, a.cache_len, a.steps, a.config, a.kv_dtype,
+                        a.w8a8)
         return 0
 
     # Validate --config up front (importing the module does not initialize
@@ -365,7 +374,8 @@ def main() -> int:
     tiers = [t for t in tiers if not (t in seen or seen.add(t))]
     last_err = "no tiers ran"
     for i, (batch, cache_len, config) in enumerate(tiers):
-        argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype)
+        argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype,
+                          a.w8a8)
         try:
             proc = subprocess.run(
                 argv, capture_output=True, text=True, timeout=a.run_timeout,

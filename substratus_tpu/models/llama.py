@@ -28,7 +28,7 @@ from jax import lax
 
 from substratus_tpu.ops.attention import dot_product_attention
 from substratus_tpu.ops.basics import rms_norm, rope, swiglu, lora_delta
-from substratus_tpu.ops.quant import materialize, qeinsum
+from substratus_tpu.ops.quant import materialize, qeinsum, qeinsum_w8a8
 
 Params = Dict[str, Any]
 
@@ -63,6 +63,15 @@ class LlamaConfig:
     #   "xla"    — scale-after-dot einsums (default; also fastest measured)
     #   "pallas" — fused int8-dequant flash-decode Mosaic kernel
     decode_attn_impl: str = "xla"
+    # Multi-token cached attention (chunked prefill / speculative verify):
+    #   "xla"   — dequantize cache + reference attention
+    #   "flash" — blockwise Pallas kernel (ops/flash_attention.py::
+    #             flash_cached_attention); TPU serving default
+    chunk_attn_impl: str = "xla"
+    # W8A8: dynamically quantize activations per token so quantized matmuls
+    # run in the MXU's native s8xs8 mode (ops/quant.py::qeinsum_w8a8).
+    # Opt-in; weight-only int8 (qeinsum) is the default quantized path.
+    quant_activations: bool = False
     # Mixture-of-experts (Mixtral family): n_experts == 0 means dense MLP.
     # Routed top-k with GShard-style capacity dispatch; expert weights shard
     # over the "expert" mesh axis (expert parallelism).
@@ -350,9 +359,11 @@ def _moe_ffn(
     E, k = cfg.n_experts, cfg.n_experts_per_token
     lora = lora or {}
 
+    qe = qeinsum_w8a8 if cfg.quant_activations else qeinsum
+
     def eproj(name, x, eq_w, eq_a, eq_b):
         """Per-expert projection with optional expert-routed LoRA delta."""
-        out = qeinsum(eq_w, x, lp[name], dt)
+        out = qe(eq_w, x, lp[name], dt)
         if name in lora:
             down = jnp.einsum(eq_a, x, lora[name]["a"].astype(dt))
             out = out + jnp.einsum(
@@ -439,8 +450,10 @@ def _block(
     dt = cfg.dtype
     lora = lora_layers or {}
 
+    qe = qeinsum_w8a8 if cfg.quant_activations else qeinsum
+
     def proj(name: str, inp: jnp.ndarray, eq: str, lora_eq: str) -> jnp.ndarray:
-        out = qeinsum(eq, inp, lp[name], dt)
+        out = qe(eq, inp, lp[name], dt)
         if name in lora:
             out = out + lora_delta(inp, lora[name], lora_scale, lora_eq)
         return out
@@ -471,6 +484,7 @@ def _block(
         attn, kv_out = update_cache_and_attend(
             layer_cache, q, kk, vv, positions,
             kv_length=kv_length, impl=cfg.decode_attn_impl,
+            chunk_impl=cfg.chunk_attn_impl,
         )
 
     b, s = x.shape[:2]
@@ -553,7 +567,9 @@ def forward(
             "bsd,vd->bsv", x, materialize(params["tok_embed"], cfg.dtype)
         )
     else:
-        logits = qeinsum("bsd,dv->bsv", x, params["lm_head"], cfg.dtype)
+        logits = (qeinsum_w8a8 if cfg.quant_activations else qeinsum)(
+            "bsd,dv->bsv", x, params["lm_head"], cfg.dtype
+        )
     kv = ys["kv"]  # stacked over layers; same structure as the cache
     if cfg.n_experts > 0 and cache is None:
         # Per-layer router load-balancing losses (training/prefill only —

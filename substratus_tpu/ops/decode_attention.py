@@ -235,14 +235,18 @@ def update_cache_and_attend(
     *,
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid prefix override
     impl: str = "xla",
+    chunk_impl: str = "xla",
 ):
     """Scatter fresh kv entries into a per-layer slot cache and attend.
 
     The one cached-attention path shared by every model family: quantizes
     on the way in when the cache is int8, runs the bandwidth-critical
-    decode_attention for single-token steps, and falls back to the
-    dequantize-and-reference path for multi-token continuation (chunked
-    prefill / speculative verify) or kv_length-masked resumes.
+    decode_attention for single-token steps, and — for multi-token
+    continuation (chunked prefill / speculative verify) or
+    kv_length-masked resumes — either the blockwise Pallas kernel
+    (chunk_impl="flash": int8 operands convert per-block in VMEM, no
+    dequantized HBM copy, no [Sq, Sk] score matrix) or the
+    dequantize-and-reference fallback (chunk_impl="xla").
 
     Returns (attn [B, S, H, D], kv_out — the updated cache dict).
     """
@@ -284,6 +288,13 @@ def update_cache_and_attend(
             q, kv_out["k"], kv_out["v"], positions[:, 0],
             kv_out.get("k_scale"), kv_out.get("v_scale"),
             impl=impl,
+        )
+    elif chunk_impl == "flash":
+        from substratus_tpu.ops.flash_attention import flash_cached_attention
+
+        attn = flash_cached_attention(
+            q, kv_out["k"], kv_out["v"], positions,
+            kv_out.get("k_scale"), kv_out.get("v_scale"), kv_length,
         )
     else:
         if quantized:

@@ -1,22 +1,34 @@
-"""Pallas (Mosaic) flash attention for TPU.
+"""Pallas (Mosaic) flash attention for TPU — forward AND backward kernels.
 
 The reference's attention ran inside closed CUDA images; here it is a real
 kernel: blockwise causal attention with online softmax so the [Sq, Sk] score
 matrix never materializes in HBM — the classic memory win that makes long
 context affordable.
 
-Layout: grid (batch*heads, q_blocks, k_blocks) with the k dimension
+Forward layout: grid (batch*heads, q_blocks, k_blocks) with the k dimension
 sequential ("arbitrary") so VMEM scratch (running max m, normalizer l, and
-the f32 accumulator) persists across k steps; the output tile is written
-once on the final k step. GQA is handled in the k/v index maps (query head
-h reads kv head h // group) — no KV duplication in HBM. Fully-masked
-diagonal-above blocks are skipped via pl.when, so causal attention does
-~half the work.
+the f32 accumulator) persists across k steps; the output tile and the
+row logsumexp L = m + log(l) are written once on the final k step. GQA is
+handled in the k/v index maps (query head h reads kv head h // group) — no
+KV duplication in HBM. Fully-masked diagonal-above blocks are skipped via
+pl.when, so causal attention does ~half the work.
 
-Backward: custom_vjp whose bwd recomputes attention with the XLA reference
-implementation (ops/attention.py) and differentiates that — flash forward
-speed + remat-style memory behavior without a hand-written backward kernel
-(that lands in a later round).
+Backward (standard flash bwd, recompute-from-stats):
+  D  = rowsum(dO * O)                      (XLA, one fused pass)
+  p  = exp(s * scale - L)                  (recomputed per block in VMEM)
+  dV = p^T dO
+  dS = p * (dO V^T - D) * scale
+  dQ = dS K     — kernel over (bh, q_blocks) accumulating across k blocks
+  dK = dS^T Q   — kernel over (bh, k_blocks) accumulating across q blocks
+Neither kernel materializes p in HBM. For GQA the dK/dV kernel runs per
+query head and the per-head partials are summed over the group afterwards
+(group-sized HBM transient; zero-cost for MHA).
+
+Numerics: dots run in the input dtype (bf16 is the MXU's native mode; an
+f32 upcast would be truncated back to bf16 under default precision —
+measured 7e-3 on chip) with f32 accumulation; genuine f32 inputs request
+Precision.HIGHEST, making the kernel f32-exact (1.1e-6 vs the oracle on a
+real v5e).
 """
 from __future__ import annotations
 
@@ -27,11 +39,20 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from substratus_tpu.ops.attention import dot_product_attention
-
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
+
+
+def _precision(dtype):
+    return jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+
+
+def _dot(a, b, dims, prec):
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())),
+        preferred_element_type=jnp.float32, precision=prec,
+    )
 
 
 def _flash_kernel(
@@ -39,16 +60,20 @@ def _flash_kernel(
     k_ref,  # [1, bk, D]
     v_ref,  # [1, bk, D]
     o_ref,  # [1, bq, D]
-    m_scratch,  # [bq, 128] f32
-    l_scratch,  # [bq, 128] f32
-    acc_scratch,  # [bq, D] f32
-    *,
+    *rest,  # emit_lse: lse_ref [1, bq, 8] f32 (row value broadcast across
+    #         8 lanes — the narrowest block Mosaic accepts for a per-row
+    #         vector; written only for the custom_vjp forward, the
+    #         inference path skips the dead HBM write); then 3 scratches
+    #         m [bq,128], l [bq,128], acc [bq,D] f32
     scale: float,
     causal: bool,
     block_q: int,
     block_k: int,
     num_k_blocks: int,
+    emit_lse: bool,
 ):
+    lse_ref = rest[0] if emit_lse else None
+    m_scratch, l_scratch, acc_scratch = rest[-3:]
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -67,13 +92,11 @@ def _flash_kernel(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
-        k = k_ref[0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
+        q = q_ref[0]  # [bq, D] input dtype
+        k = k_ref[0]  # [bk, D]
+        v = v_ref[0]
+        prec = _precision(q.dtype)
+        s = _dot(q, k, ((1,), (1,)), prec) * scale  # [bq, bk] f32
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
@@ -85,18 +108,25 @@ def _flash_kernel(
         p = jnp.exp(s - m_new)  # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = alpha * l_scratch[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        pv = p if v.dtype == jnp.float32 else p.astype(v.dtype)
+        acc_scratch[:] = acc_scratch[:] * alpha + _dot(
+            pv, v, ((1,), (0,)), prec
         )
         m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
         l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
 
     @pl.when(ik == num_k_blocks - 1)
     def _finalize():
+        m = m_scratch[:, :1]
         l = l_scratch[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        if emit_lse:
+            # logsumexp per row; NEG_INF rows (nothing live) stay NEG_INF
+            # so the backward's exp(s - L) underflows to 0 instead of
+            # exploding.
+            lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
 def _flash_forward(
@@ -108,16 +138,21 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jnp.ndarray:
+    need_lse: bool = True,
+):
     b, sq, h, d = q.shape
     sk, kh = k.shape[1], k.shape[2]
     assert h % kh == 0
     group = h // kh
+    # Shrink blocks to divide the sequence (non-power-of-two prefill
+    # buckets like 384 must not crash; a smaller block only costs a bit
+    # of grid overhead).
     block_q = min(block_q, sq)
+    while sq % block_q:
+        block_q //= 2
     block_k = min(block_k, sk)
-    assert sq % block_q == 0 and sk % block_k == 0, (
-        f"seq lengths ({sq}, {sk}) must divide blocks ({block_q}, {block_k})"
-    )
+    while sk % block_k:
+        block_k //= 2
     nq, nk = sq // block_q, sk // block_k
 
     # [B, S, H, D] -> [B*H, S, D] view via BlockSpec index maps.
@@ -133,6 +168,9 @@ def _flash_forward(
         head = bh % h
         return (batch * kh + head // group, ik, 0)
 
+    def lse_index(bh, iq, ik):
+        return (bh, iq, 0)
+
     kernel = functools.partial(
         _flash_kernel,
         scale=scale,
@@ -140,8 +178,21 @@ def _flash_forward(
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=nk,
+        emit_lse=need_lse,
     )
-    out = pl.pallas_call(
+    if need_lse:
+        out_specs = [
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 8), lse_index),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32),
+        ]
+    else:
+        out_specs = pl.BlockSpec((1, block_q, d), q_index)
+        out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -149,6 +200,378 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = res[0] if need_lse else res
+    lse = res[1] if need_lse else None
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # lse/delta [1, bq, 8]
+    dq_ref,  # [1, bq, D] output
+    dq_scratch,  # [bq, D] f32
+    *,
+    scale, causal, block_q, block_k, num_k_blocks,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = True
+    if causal:
+        live = k_start <= q_start + block_q - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        prec = _precision(q.dtype)
+        s = _dot(q, k, ((1,), (1,)), prec) * scale  # [bq, bk] f32
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
+        dp = _dot(do, v, ((1,), (1,)), prec)  # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dsq = ds if q.dtype == jnp.float32 else ds.astype(q.dtype)
+        dq_scratch[:] += _dot(dsq, k, ((1,), (0,)), prec)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # lse/delta [1, bq, 8]
+    dk_ref, dv_ref,  # [1, bk, D] outputs (per query head)
+    dk_scratch, dv_scratch,  # [bk, D] f32
+    *,
+    scale, causal, block_q, block_k, num_q_blocks,
+):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = True
+    if causal:
+        live = k_start <= q_start + block_q - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        prec = _precision(q.dtype)
+        s = _dot(q, k, ((1,), (1,)), prec) * scale  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
+        pq = p if q.dtype == jnp.float32 else p.astype(q.dtype)
+        dv_scratch[:] += _dot(pq, do, ((0,), (0,)), prec)  # p^T dO
+        dp = _dot(do, v, ((1,), (1,)), prec)  # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dsq = ds if q.dtype == jnp.float32 else ds.astype(q.dtype)
+        dk_scratch[:] += _dot(dsq, q, ((0,), (0,)), prec)  # dS^T Q
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, scale, causal, block_q, block_k, interpret
+):
+    b, sq, h, d = q.shape
+    sk, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    block_q = min(block_q, sq)
+    while sq % block_q:
+        block_q //= 2
+    block_k = min(block_k, sk)
+    while sk % block_k:
+        block_k //= 2
+    nq, nk = sq // block_q, sk // block_k
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kh, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kh, sk, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # D_i = rowsum(dO * O): one fused elementwise+reduce pass in XLA,
+    # broadcast to the same [bh, sq, 8] lane layout as lse.
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        ).transpose(0, 2, 1).reshape(b * h, sq)[:, :, None],
+        (b * h, sq, 8),
+    )
+
+    def dq_q_index(bh, iq, ik):
+        return (bh, iq, 0)
+
+    def dq_kv_index(bh, iq, ik):
+        batch = bh // h
+        head = bh % h
+        return (batch * kh + head // group, ik, 0)
+
+    def dq_lse_index(bh, iq, ik):
+        return (bh, iq, 0)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+    )
+    dqt = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), dq_q_index),
+            pl.BlockSpec((1, block_k, d), dq_kv_index),
+            pl.BlockSpec((1, block_k, d), dq_kv_index),
+            pl.BlockSpec((1, block_q, d), dq_q_index),
+            pl.BlockSpec((1, block_q, 8), dq_lse_index),
+            pl.BlockSpec((1, block_q, 8), dq_lse_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), dq_q_index),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    dq = dqt.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+    # dK/dV per QUERY head (grid bh), then reduced over the GQA group —
+    # parallel programs must not accumulate into a shared kv block.
+    def dkv_q_index(bh, ik, iq):
+        return (bh, iq, 0)
+
+    def dkv_kv_index(bh, ik, iq):
+        batch = bh // h
+        head = bh % h
+        return (batch * kh + head // group, ik, 0)
+
+    def dkv_out_index(bh, ik, iq):
+        return (bh, ik, 0)
+
+    def dkv_lse_index(bh, ik, iq):
+        return (bh, iq, 0)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+    )
+    dkt, dvt = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), dkv_q_index),
+            pl.BlockSpec((1, block_k, d), dkv_kv_index),
+            pl.BlockSpec((1, block_k, d), dkv_kv_index),
+            pl.BlockSpec((1, block_q, d), dkv_q_index),
+            pl.BlockSpec((1, block_q, 8), dkv_lse_index),
+            pl.BlockSpec((1, block_q, 8), dkv_lse_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), dkv_out_index),
+            pl.BlockSpec((1, block_k, d), dkv_out_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_k, d), jnp.float32),
+            _vmem((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    # heads are kv-major (h = khead * group + r) -> sum the group axis.
+    dk = dkt.reshape(b, kh, group, sk, d).sum(2).astype(k.dtype)
+    dv = dvt.reshape(b, kh, group, sk, d).sum(2).astype(v.dtype)
+    return dq, dk.transpose(0, 2, 1, 3), dv.transpose(0, 2, 1, 3)
+
+
+def _cached_kernel(
+    q_ref,  # [1, bq, D] (input dtype)
+    k_ref,  # [1, bk, D] cache dtype (int8 when quantized)
+    v_ref,
+    limit_ref,  # [1, bq, 8] i32: last attendable cache index per q row
+    *rest,  # quantized: ks [1, 8, bk], vs [1, 8, bk], o_ref, 3 scratches;
+    #         else: o_ref, 3 scratches
+    scale: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+    quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, o_ref = rest[:3]
+    else:
+        ks_ref = vs_ref = None
+        o_ref = rest[0]
+    m_scratch, l_scratch, acc_scratch = rest[-3:]
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    limit = limit_ref[0][:, :1]  # [bq, 1] i32
+    k_start = ik * block_k
+    # Dynamic block skip: the whole k block is dead when it starts past
+    # every row's limit (cache tail beyond the filled/causal frontier).
+    @pl.when(k_start <= jnp.max(limit))
+    def _compute():
+        q = q_ref[0]
+        dt = q.dtype
+        prec = _precision(dt)
+        k = k_ref[0].astype(dt)  # int8 cache converts in VMEM, not HBM
+        s = _dot(q, k, ((1,), (1,)), prec) * scale  # [bq, bk] f32
+        if quantized:
+            s = s * ks_ref[0][:1, :]  # k_scale commutes out of the dot
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= limit, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scratch[:] = jnp.broadcast_to(
+            alpha * l_scratch[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+            l_scratch.shape,
+        )
+        if quantized:
+            p = p * vs_ref[0][:1, :]  # v_scale folds into the probabilities
+        acc_scratch[:] = acc_scratch[:] * alpha + _dot(
+            p.astype(dt), v_ref[0].astype(dt), ((1,), (0,)), prec
+        )
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def flash_cached_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, KH, Sk, D] slot-cache layout (int8 when scales given)
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, Sq] absolute positions
+    k_scale: Optional[jnp.ndarray] = None,  # [B, KH, Sk] f32
+    v_scale: Optional[jnp.ndarray] = None,
+    kv_length: Optional[jnp.ndarray] = None,  # [B] valid-prefix mask
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Blockwise attention of a multi-token chunk against the slot KV cache
+    (chunked prefill / speculative verify): flash online softmax, int8
+    cache operands converted block-at-a-time in VMEM (never a dequantized
+    HBM copy), per-row masking at min(position, kv_length-1). Inference
+    only (no vjp). Returns [B, Sq, H, D] in q.dtype."""
+    b, sq, h, d = q.shape
+    kh, sk = k.shape[1], k.shape[2]
+    assert h % kh == 0
+    group = h // kh
+    block_q = min(block_q, sq)
+    while sq % block_q:
+        block_q //= 2
+    block_k = min(block_k, sk)
+    while sk % block_k:
+        block_k //= 2
+    nq, nk = sq // block_q, sk // block_k
+    quantized = k_scale is not None
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.reshape(b * kh, sk, d)
+    vt = v.reshape(b * kh, sk, d)
+    limit = q_positions
+    if kv_length is not None:
+        limit = jnp.minimum(limit, kv_length[:, None] - 1)
+    limit8 = jnp.broadcast_to(
+        limit.astype(jnp.int32)[:, :, None], (b, sq, 8)
+    )
+
+    def q_index(bh, iq, ik):
+        return (bh, iq, 0)
+
+    def kv_index(bh, iq, ik):
+        batch = bh // h
+        head = bh % h
+        return (batch * kh + head // group, ik, 0)
+
+    def limit_index(bh, iq, ik):
+        return (bh // h, iq, 0)
+
+    def scale_index(bh, iq, ik):
+        batch = bh // h
+        head = bh % h
+        return (batch * kh + head // group, 0, ik)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_q, 8), limit_index),
+    ]
+    operands = [qt, kt, vt, limit8]
+    if quantized:
+        ks8 = jnp.broadcast_to(
+            k_scale[:, :, None, :], (b, kh, 8, sk)
+        ).reshape(b * kh, 8, sk)
+        vs8 = jnp.broadcast_to(
+            v_scale[:, :, None, :], (b, kh, 8, sk)
+        ).reshape(b * kh, 8, sk)
+        in_specs += [
+            pl.BlockSpec((1, 8, block_k), scale_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+        ]
+        operands += [ks8, vs8]
+
+    kernel = functools.partial(
+        _cached_kernel,
+        scale=d ** -0.5,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=nk,
+        quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), q_index),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[
@@ -158,7 +581,7 @@ def _flash_forward(
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
@@ -193,24 +616,28 @@ def flash_attention(
     (no-cache) path. Shapes [B, S, H|KH, D]."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(
+        q, k, v, scale, causal, block_q, block_k, interpret, need_lse=False
+    )
+    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(
-        q, k, v, causal, scale, block_q, block_k, interpret
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(
+        q, k, v, scale, causal, block_q, block_k, interpret
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-
-    def ref(q, k, v):
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_backward(
+        q, k, v, out, lse, g, scale, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -9,8 +9,10 @@ equivalent:
 
     python -m substratus_tpu.serve.main [--model /content/model] [--port 8080]
 
-Params (from /content/params.json or flags): quantize=int8|none,
-max_batch, max_seq_len, config (named config for weightless smoke runs).
+Params (from /content/params.json or flags): quantize=int8|w8a8|none
+(w8a8 = int8 weights + dynamic per-token int8 activations on the MXU's
+native s8xs8 path), max_batch, max_seq_len, config (named config for
+weightless smoke runs).
 """
 from __future__ import annotations
 
@@ -39,7 +41,9 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--max-seq-len", type=int, default=None)
-    ap.add_argument("--quantize", default=None, choices=["int8", "none"])
+    ap.add_argument(
+        "--quantize", default=None, choices=["int8", "w8a8", "none"]
+    )
     ap.add_argument(
         "--draft-model", default=None,
         help="draft checkpoint dir for speculative decoding",
@@ -65,7 +69,8 @@ def main(argv=None) -> int:
         params_json,
         (
             "model", "config", "quantize", "max_batch", "max_seq_len",
-            "max_prefill_len", "kv_cache_dtype", "attn_impl", "tensor",
+            "max_prefill_len", "kv_cache_dtype", "attn_impl",
+            "chunk_attn_impl", "tensor",
             "replicas", "draft_model", "spec_k",
         ),
         "serve.main",
@@ -112,7 +117,7 @@ def main(argv=None) -> int:
 
     family = registry.module_of(cfg)
 
-    if quantize == "int8":
+    if quantize in ("int8", "w8a8"):
         if family is llama:
             from substratus_tpu.ops.quant import is_quantized, quantize_params
 
@@ -120,14 +125,26 @@ def main(argv=None) -> int:
                 params = jax.jit(
                     lambda p: quantize_params(p, llama.quant_contracting(cfg))
                 )(params)
+            if quantize == "w8a8":
+                cfg = cfg.replace(quant_activations=True)
         else:
             print("int8 quantization not supported for this family; skipping")
 
     if family is llama:
         # Serving picks its own attention impl (never inherited from
-        # training): XLA reference by default; params.json
-        # {"attn_impl": "flash"} opts a TPU server into the Pallas kernel.
-        cfg = cfg.replace(attn_impl=params_json.get("attn_impl", "xla"))
+        # training). On TPU the Pallas flash kernel is the prefill default
+        # (validated bit-close and never slower on chip, 1.15x at 8k
+        # context, and it keeps the [S, S] score matrix out of HBM); other
+        # backends get the XLA reference. params.json {"attn_impl": ...}
+        # overrides either way.
+        default_impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        cfg = cfg.replace(
+            attn_impl=params_json.get("attn_impl", default_impl),
+            # The cached-chunk kernel is parity-tested but its Mosaic
+            # lowering has not yet run on a chip (tunnel wedged before the
+            # validation completed) — opt-in until it has.
+            chunk_attn_impl=params_json.get("chunk_attn_impl", "xla"),
+        )
 
     ec = EngineConfig(
         max_batch=max_batch,
@@ -165,7 +182,7 @@ def main(argv=None) -> int:
         draft_cfg, draft_params = load_checkpoint(draft_dir)
         if registry.module_of(draft_cfg) is not family:
             raise SystemExit("draft model must be the same family as the target")
-        if quantize == "int8" and family is llama:
+        if quantize in ("int8", "w8a8") and family is llama:
             from substratus_tpu.ops.quant import is_quantized, quantize_params
 
             if not is_quantized(draft_params):
@@ -176,6 +193,8 @@ def main(argv=None) -> int:
                         p, llama.quant_contracting(draft_cfg)
                     )
                 )(draft_params)
+            if quantize == "w8a8":
+                draft_cfg = draft_cfg.replace(quant_activations=True)
         draft = (draft_cfg, draft_params)
         ec.spec_k = spec_k
         print(f"speculative decoding: draft={draft_dir} k={spec_k}", flush=True)

@@ -39,14 +39,21 @@ def test_flash_noncausal():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_flash_backward_matches_reference():
-    q, k, v = _qkv(s=128)
+@pytest.mark.parametrize(
+    "kh,causal",
+    [(4, True), (2, True), (4, False)],
+    ids=["mha-causal", "gqa-causal", "mha-noncausal"],
+)
+def test_flash_backward_matches_reference(kh, causal):
+    """The Pallas backward kernels (dQ over k-blocks, dK/dV over q-blocks
+    with GQA group reduction) vs differentiating the XLA oracle."""
+    q, k, v = _qkv(s=128, kh=kh)
 
     def loss_flash(q, k, v):
-        return (flash_attention(q, k, v, True, None, 64, 64, True) ** 2).sum()
+        return (flash_attention(q, k, v, causal, None, 64, 64, True) ** 2).sum()
 
     def loss_ref(q, k, v):
-        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+        return (dot_product_attention(q, k, v, causal=causal) ** 2).sum()
 
     g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
@@ -116,4 +123,77 @@ def test_ring_attention_matches_reference(mesh8, ring_size):
         out_specs=P("data", "sequence", None, None),
     )
     out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("quantized", [False, True], ids=["bf16", "int8"])
+@pytest.mark.parametrize("kh", [4, 2], ids=["mha", "gqa"])
+def test_flash_cached_attention_matches_fallback(quantized, kh):
+    """The chunked-prefill flash kernel vs the dequantize-and-reference
+    path update_cache_and_attend uses (ops/decode_attention.py)."""
+    from substratus_tpu.ops.flash_attention import flash_cached_attention
+    from substratus_tpu.ops.quant import dequantize_kv, quantize_kv
+
+    b, sq, h, d, sk = 2, 16, 4, 32, 128
+    ks = jax.random.split(jax.random.key(3), 4)
+    q = jax.random.normal(ks[0], (b, sq, h, d), jnp.float32)
+    k_act = jax.random.normal(ks[1], (b, sk, kh, d), jnp.float32)
+    v_act = jax.random.normal(ks[2], (b, sk, kh, d), jnp.float32)
+    # Chunk occupies positions [pos0, pos0+sq); tail of the cache is junk.
+    pos0 = 64
+    positions = pos0 + jnp.arange(sq)[None, :] + jnp.zeros((b, 1), jnp.int32)
+
+    kT = k_act.transpose(0, 2, 1, 3)  # [B, KH, Sk, D] cache layout
+    vT = v_act.transpose(0, 2, 1, 3)
+    if quantized:
+        kq, kscale = quantize_kv(kT)
+        vq, vscale = quantize_kv(vT)
+        kscale, vscale = kscale[..., 0], vscale[..., 0]
+        k_cache, v_cache = kq, vq
+        k_ref_act = dequantize_kv(kq, kscale[..., None], jnp.float32)
+        v_ref_act = dequantize_kv(vq, vscale[..., None], jnp.float32)
+    else:
+        k_cache, v_cache = kT, vT
+        kscale = vscale = None
+        k_ref_act, v_ref_act = kT, vT
+
+    ref = dot_product_attention(
+        q, k_ref_act.transpose(0, 2, 1, 3), v_ref_act.transpose(0, 2, 1, 3),
+        causal=True, q_positions=positions,
+    )
+    out = flash_cached_attention(
+        q, k_cache, v_cache, positions, kscale, vscale,
+        block_q=8, block_k=32, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_cached_attention_kv_length():
+    from substratus_tpu.ops.flash_attention import flash_cached_attention
+
+    b, sq, h, d, sk = 1, 8, 2, 32, 64
+    ks = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(ks[0], (b, sq, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, sk, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, sk, d), jnp.float32)
+    positions = 40 + jnp.arange(sq)[None, :]
+    kv_len = jnp.array([20], jnp.int32)  # only the first 20 slots are real
+
+    ref = dot_product_attention(
+        q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        causal=True, q_positions=positions, kv_length=kv_len,
+    )
+    out = flash_cached_attention(
+        q, k, v, positions, kv_length=kv_len,
+        block_q=8, block_k=32, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_non_divisible_bucket():
+    """A 384-token prefill bucket (not a multiple of the 256 default
+    block) must shrink the block instead of asserting."""
+    q, k, v = _qkv(s=384, h=2, kh=2, d=16)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, None, 256, 256, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -89,3 +89,52 @@ def test_int8_quant_close():
     # int8 weight-only: logits track within a loose tolerance, argmax mostly agrees
     agree = (dense.argmax(-1) == quant.argmax(-1)).mean()
     assert agree > 0.9, float(agree)
+
+
+def test_w8a8_quant_close():
+    """W8A8 (dynamic per-token activation quant, s8xs8 MXU dots) tracks the
+    dense model nearly as well as weight-only int8."""
+    from substratus_tpu.ops.quant import quantize_params
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params, llama.quant_contracting(cfg))
+    tokens = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+
+    dense, _ = llama.forward(params, tokens, cfg)
+    w8a8_cfg = cfg.replace(quant_activations=True)
+    quant, _ = llama.forward(qparams, tokens, w8a8_cfg)
+    agree = (dense.argmax(-1) == quant.argmax(-1)).mean()
+    assert agree > 0.85, float(agree)
+
+
+def test_w8a8_decode_matches_weight_only():
+    """Cached decode runs under quant_activations (the serving config
+    flag) and produces nearly the same greedy tokens."""
+    from substratus_tpu.ops.quant import quantize_params
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params, llama.quant_contracting(cfg))
+
+    def greedy(cfg):
+        cache = llama.init_cache(cfg, 1, 32)
+        tokens = jnp.array([[1, 5, 9]], jnp.int32)
+        logits, cache = llama.forward(
+            params=qparams, tokens=tokens, cfg=cfg,
+            positions=jnp.arange(3)[None], cache=cache,
+        )
+        out = []
+        tok = logits[:, -1].argmax(-1).astype(jnp.int32)
+        for i in range(6):
+            out.append(int(tok[0]))
+            logits, cache = llama.decode_step(
+                qparams, cache, tok, jnp.array([3 + i], jnp.int32), cfg
+            )
+            tok = logits.argmax(-1).astype(jnp.int32)
+        return out
+
+    base = greedy(cfg)
+    w8a8 = greedy(cfg.replace(quant_activations=True))
+    agree = sum(a == b for a, b in zip(base, w8a8))
+    assert agree >= 4, (base, w8a8)

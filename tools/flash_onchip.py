@@ -1,0 +1,133 @@
+"""On-chip validation + timing of the Pallas flash attention kernel
+(compiled Mosaic lowering, not interpret mode) vs the XLA oracle."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from substratus_tpu.ops.attention import dot_product_attention
+from substratus_tpu.ops.flash_attention import flash_attention
+
+
+def sync(x):
+    jnp.ravel(x)[0].item()
+
+
+def timeit1(fn, *args, n=5):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def parity(b, s, h, kh, d, dtype, causal, atol):
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, kh, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, kh, d), dtype)
+    with jax.default_matmul_precision(
+        "highest" if dtype == jnp.float32 else "default"
+    ):
+        ref = jax.jit(partial(dot_product_attention, causal=causal))(q, k, v)
+        out = jax.jit(partial(flash_attention, causal=causal))(q, k, v)
+    err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    ok = float(err) < atol
+    print(f"parity b={b} s={s} h={h}/{kh} d={d} {dtype.__name__} "
+          f"causal={causal}: max_err={float(err):.2e} {'OK' if ok else 'FAIL'}",
+          flush=True)
+    return ok
+
+
+def bench_shape(b, s, h, kh, d, dtype):
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, kh, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, kh, d), dtype)
+    t_ref = timeit1(jax.jit(partial(dot_product_attention, causal=True)), q, k, v)
+    t_fl = timeit1(jax.jit(lambda q, k, v: flash_attention(q, k, v, True)), q, k, v)
+    # causal flops: ~0.5 * 4 * b*h*s^2*d
+    flops = 2.0 * b * h * s * s * d
+    print(f"bench b={b} s={s} h={h}/{kh} d={d}: xla {t_ref*1e3:7.2f}ms "
+          f"({flops/t_ref/1e12:5.1f} TF/s)  flash {t_fl*1e3:7.2f}ms "
+          f"({flops/t_fl/1e12:5.1f} TF/s)  speedup {t_ref/t_fl:5.2f}x",
+          flush=True)
+
+
+def bwd_parity(b, s, h, kh, d, dtype, causal, atol):
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, kh, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, kh, d), dtype)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal).astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=causal)
+                .astype(jnp.float32) ** 2).sum()
+
+    with jax.default_matmul_precision(
+        "highest" if dtype == jnp.float32 else "default"
+    ):
+        g1 = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    ok = True
+    for name, a, bb in zip("qkv", g1, g2):
+        scale_ref = float(jnp.max(jnp.abs(bb.astype(jnp.float32)))) or 1.0
+        err = float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - bb.astype(jnp.float32)
+        ))) / scale_ref
+        good = err < atol
+        ok &= good
+        print(f"bwd d{name} b={b} s={s} h={h}/{kh} {dtype.__name__} "
+              f"causal={causal}: rel_err={err:.2e} {'OK' if good else 'FAIL'}",
+              flush=True)
+    return ok
+
+
+def bench_bwd(b, s, h, kh, d, dtype):
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, kh, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, kh, d), dtype)
+
+    gf = jax.jit(jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, True)
+                         .astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2)))
+    gr = jax.jit(jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, causal=True)
+                         .astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2)))
+    t_fl = timeit1(lambda *a: gf(*a)[0], q, k, v)
+    t_ref = timeit1(lambda *a: gr(*a)[0], q, k, v)
+    print(f"bench bwd b={b} s={s} h={h}/{kh}: xla {t_ref*1e3:7.2f}ms  "
+          f"flash {t_fl*1e3:7.2f}ms  speedup {t_ref/t_fl:5.2f}x", flush=True)
+
+
+def main():
+    ok = True
+    ok &= parity(2, 512, 8, 8, 128, jnp.float32, True, 2e-5)
+    ok &= parity(2, 512, 8, 2, 128, jnp.bfloat16, True, 3e-2)
+    ok &= parity(1, 1024, 8, 8, 64, jnp.bfloat16, False, 3e-2)
+    ok &= bwd_parity(2, 512, 8, 8, 128, jnp.float32, True, 1e-4)
+    ok &= bwd_parity(2, 512, 8, 2, 128, jnp.bfloat16, True, 4e-2)
+    if not ok:
+        print("PARITY FAILURES — not benching")
+        return
+    bench_shape(1, 8192, 32, 32, 128, jnp.bfloat16)   # long-context prefill
+    bench_bwd(1, 4096, 32, 32, 128, jnp.bfloat16)
+    bench_bwd(1, 8192, 16, 16, 128, jnp.bfloat16)
+
+
+if __name__ == "__main__":
+    main()

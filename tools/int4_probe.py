@@ -1,0 +1,68 @@
+"""On-chip probe: is native jnp.int4 weight storage viable for decode?
+
+Measures a decode-shaped matmul chain with int8 vs int4 weights (XLA
+native int4 arrays, scale-after-dot) using in-graph repetition.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+B, D, F, L = 24, 4096, 11008, 16
+
+
+def sync(x):
+    jnp.ravel(jax.tree.leaves(x)[0])[0].item()
+
+
+def timeit1(fn, *args, n=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    key = jax.random.key(0)
+    keys = jax.random.split(key, L)
+    w8 = [jax.random.randint(k, (D, F), -127, 128, jnp.int8) for k in keys]
+    try:
+        w4 = [w.astype(jnp.int4) for w in w8]  # values clip; timing only
+        _ = jax.jit(lambda x: x.astype(jnp.bfloat16))(w4[0])
+        sync(_)
+        print("int4 arrays + convert compile OK")
+    except Exception as e:  # noqa: BLE001
+        print(f"int4 unsupported: {type(e).__name__}: {str(e)[:300]}")
+        return
+    scales = [jnp.full((1, F), 0.01, jnp.float32) for _ in keys]
+    x = jax.random.normal(key, (B, D), jnp.bfloat16)
+
+    def chain(x, ws, ss):
+        for w, s in zip(ws, ss):
+            y = jax.lax.dot_general(
+                x, w.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * s
+            x = jnp.tanh(y[:, :D]).astype(jnp.bfloat16)
+        return x
+
+    f8 = jax.jit(lambda x, *a: chain(x, a[:L], a[L:]))
+    f4 = jax.jit(lambda x, *a: chain(x, a[:L], a[L:]))
+    t8 = timeit1(f8, x, *w8, *scales)
+    t4 = timeit1(f4, x, *w4, *scales)
+    gb8 = L * D * F / 1e9
+    print(f"chain int8 scale-after: {t8*1e3:8.2f}ms ({gb8/t8:5.0f} GB/s int8)")
+    print(f"chain int4 scale-after: {t4*1e3:8.2f}ms ({gb8/2/t4:5.0f} GB/s int4)"
+          f"  speedup {t8/t4:4.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -64,9 +64,10 @@ class LlamaConfig:
     #   "pallas" — fused int8-dequant flash-decode Mosaic kernel
     decode_attn_impl: str = "xla"
     # Multi-token cached attention (chunked prefill / speculative verify):
-    #   "xla"   — dequantize cache + reference attention
+    #   "xla"   — dequantize cache + reference attention (default)
     #   "flash" — blockwise Pallas kernel (ops/flash_attention.py::
-    #             flash_cached_attention); TPU serving default
+    #             flash_cached_attention); opt-in via params.json until
+    #             its Mosaic lowering is validated on a chip
     chunk_attn_impl: str = "xla"
     # W8A8: dynamically quantize activations per token so quantized matmuls
     # run in the MXU's native s8xs8 mode (ops/quant.py::qeinsum_w8a8).

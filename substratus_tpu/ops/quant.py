@@ -105,7 +105,7 @@ def qeinsum_w8a8(eq: str, x: jnp.ndarray, w: Any,
     argmax flips (test_llama_parity::test_w8a8_quant_close).
     """
     if not isinstance(w, QTensor):
-        return jnp.einsum(eq, x, materialize(w, dtype))
+        return qeinsum(eq, x, w, dtype)
     ins, out = eq.split("->")
     xsub, wsub = ins.split(",")
     contracted = [c for c in xsub if c not in out]

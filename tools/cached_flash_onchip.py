@@ -73,7 +73,8 @@ def main():
     ok &= run(1, 512, 32, 32, 128, 8192, 6000)   # long-context chunk
     ok &= run(8, 8, 32, 32, 128, 2048, 1500)     # spec-verify shape
     print("ALL OK" if ok else "FAILURES")
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

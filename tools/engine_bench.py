@@ -1,0 +1,96 @@
+"""Engine-level serving throughput: aggregate tok/s through the full
+continuous-batching engine (scheduler, prefill, paged KV, sampling, stop
+handling) — the number a user of the HTTP server actually sees, vs
+bench.py's raw decode-step roofline.
+
+    python tools/engine_bench.py [--config llama2-7b] [--requests 64]
+        [--prompt-len 128] [--max-tokens 64] [--batch 24]
+"""
+import argparse
+import sys
+import threading
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="llama2-7b")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=24)
+    ap.add_argument("--max-seq-len", type=int, default=512)
+    ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
+    a = ap.parse_args()
+
+    # Honor an explicit JAX_PLATFORMS=cpu even under an injected
+    # accelerator plugin whose tunnel may hang (utils/jaxenv.py).
+    from substratus_tpu.utils.jaxenv import honor_requested_platform
+
+    honor_requested_platform()
+
+    import jax
+    import numpy as np
+
+    from bench import random_quantized_params
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS[a.config]
+    params = jax.jit(lambda k: random_quantized_params(cfg, k))(
+        jax.random.key(0)
+    )
+    jax.tree.leaves(params)[0].block_until_ready()
+
+    ec = EngineConfig(
+        max_batch=a.batch,
+        max_seq_len=a.max_seq_len,
+        max_prefill_len=min(256, a.max_seq_len),
+        kv_cache_dtype=a.kv_dtype,
+    )
+    engine = Engine(cfg, params, ec)
+    engine.start()
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(10, cfg.vocab_size - 1, a.prompt_len).tolist()
+        for _ in range(a.requests)
+    ]
+
+    # Warm the executables (prefill bucket + decode) outside the clock.
+    engine.generate(prompts[0][:16], max_tokens=2, temperature=0.0)
+
+    done = []
+    lock = threading.Lock()
+
+    def run_one(p):
+        out = engine.generate(p, max_tokens=a.max_tokens, temperature=0.0)
+        with lock:
+            done.append(len(out))
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=run_one, args=(p,)) for p in prompts
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    engine.stop()
+
+    gen_tokens = sum(done)
+    total_tokens = gen_tokens + a.requests * a.prompt_len
+    print(
+        f"{{\"metric\": \"{a.config.replace('-', '_')}_engine_throughput\", "
+        f"\"value\": {gen_tokens / dt:.1f}, \"unit\": \"gen_tokens/sec\", "
+        f"\"total_tok_s\": {total_tokens / dt:.1f}, "
+        f"\"requests\": {a.requests}, \"wall_s\": {dt:.2f}}}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -123,11 +123,12 @@ def main():
     ok &= bwd_parity(2, 512, 8, 2, 128, jnp.bfloat16, True, 4e-2)
     if not ok:
         print("PARITY FAILURES — not benching")
-        return
+        return 1
     bench_shape(1, 8192, 32, 32, 128, jnp.bfloat16)   # long-context prefill
     bench_bwd(1, 4096, 32, 32, 128, jnp.bfloat16)
     bench_bwd(1, 8192, 16, 16, 128, jnp.bfloat16)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

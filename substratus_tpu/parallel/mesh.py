@@ -74,9 +74,17 @@ def build_mesh(
             )
         ici = [sizes[0] // dcn_data] + sizes[1:]
         dcn = [dcn_data] + [1] * (len(sizes) - 1)
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            ici, dcn, devices=devices
-        )
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices=devices
+            )
+        except ValueError:
+            # Virtual/CPU devices carry no slice_index attribute. They are
+            # slice-ordered by construction (jax.devices() returns process/
+            # slice order), so a plain slice-major reshape yields the same
+            # placement: the outermost data axis is the only one crossing
+            # slice boundaries.
+            dev_array = np.asarray(devices).reshape(sizes)
     else:
         dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, MESH_AXES)

@@ -271,35 +271,66 @@ def looks_oom(text: str) -> bool:
     )
 
 
-def probe_backend(timeout_s: float = 90.0, attempts: int = 3) -> str | None:
+def probe_backend(
+    timeout_s: float = 90.0, budget_s: float = 1500.0
+) -> str | None:
     """Confirm a usable jax backend exists, in a child with a hard timeout
     (a wedged device tunnel HANGS rather than fails). Returns an error
-    string, or None when healthy."""
+    string, or None when healthy.
+
+    A wedged tunnel can recover minutes later (round 2 lost its capture to
+    a ~5-minute retry window while the chip came back within the round), so
+    the retries back off exponentially across `budget_s` of wall clock
+    (default 25 min) instead of giving up after a fixed attempt count. Each
+    attempt's outcome goes to stderr so the driver log shows device health
+    over time.
+    """
     code = (
         "import jax; d = jax.devices(); "
         "print(d[0].platform, len(d), getattr(d[0], 'device_kind', ''))"
     )
     last = "unknown"
-    for i in range(attempts):
+    deadline = time.monotonic() + budget_s
+    delay = 10.0
+    attempt = 0
+    fast_failures = 0
+    while True:
+        attempt += 1
+        t0 = time.monotonic()
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout_s,
+                capture_output=True, text=True,
+                timeout=min(timeout_s, max(5.0, deadline - t0)),
             )
         except subprocess.TimeoutExpired:
             last = f"backend init hang (> {timeout_s:.0f}s; wedged tunnel?)"
         else:
             if proc.returncode == 0:
-                print(f"backend ok: {proc.stdout.strip()}", file=sys.stderr)
+                print(
+                    f"backend ok (attempt {attempt}, "
+                    f"{time.monotonic() - t0:.1f}s): {proc.stdout.strip()}",
+                    file=sys.stderr,
+                )
                 return None
             last = (proc.stderr.strip() or proc.stdout.strip())[-400:]
+            # A child that exits nonzero within seconds is deterministic
+            # (missing jax, bad install), not a wedged tunnel — don't burn
+            # the 25-min recovery budget on it.
+            if time.monotonic() - t0 < 15.0:
+                fast_failures += 1
+                if fast_failures >= 3:
+                    return last
+        remaining = deadline - time.monotonic()
         print(
-            f"backend probe attempt {i + 1}/{attempts} failed: {last}",
-            file=sys.stderr,
+            f"backend probe attempt {attempt} failed "
+            f"({remaining:.0f}s of probe budget left): {last}",
+            file=sys.stderr, flush=True,
         )
-        if i + 1 < attempts:
-            time.sleep(10.0)
-    return last
+        if remaining <= delay:
+            return last
+        time.sleep(delay)
+        delay = min(delay * 2, 300.0)
 
 
 def child_argv(batch, cache_len, steps, config, kv_dtype, w8a8):
@@ -334,6 +365,10 @@ def main() -> int:
     )
     ap.add_argument("--probe-timeout", type=float, default=90.0)
     ap.add_argument(
+        "--probe-budget", type=float, default=1500.0,
+        help="total wall-clock budget for backend probing (backoff retries)",
+    )
+    ap.add_argument(
         "--run-timeout", type=float, default=1500.0,
         help="hard wall-clock limit per measurement attempt",
     )
@@ -354,7 +389,7 @@ def main() -> int:
             f"--config {a.config!r} not in {sorted(llama.CONFIGS)}"
         )
 
-    err = probe_backend(a.probe_timeout)
+    err = probe_backend(a.probe_timeout, a.probe_budget)
     if err is not None:
         emit_failure(a.config, f"backend unavailable: {err}")
         return 0
@@ -373,7 +408,11 @@ def main() -> int:
     seen = set()
     tiers = [t for t in tiers if not (t in seen or seen.add(t))]
     last_err = "no tiers ran"
-    for i, (batch, cache_len, config) in enumerate(tiers):
+    hang_retry = 1  # one wedge-recovery cycle: re-probe, retry same tier
+    i = 0
+    while i < len(tiers):
+        batch, cache_len, config = tiers[i]
+        i += 1
         argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype,
                           a.w8a8)
         try:
@@ -382,7 +421,18 @@ def main() -> int:
             )
         except subprocess.TimeoutExpired:
             last_err = f"measurement hang (> {a.run_timeout:.0f}s)"
-            break  # a hang will not get better at a smaller tier
+            # A hang will not get better at a smaller tier — but the tunnel
+            # may recover. Re-probe (short budget) and retry this tier once.
+            if hang_retry > 0:
+                hang_retry -= 1
+                print(
+                    "measurement hung; re-probing backend before one retry",
+                    file=sys.stderr, flush=True,
+                )
+                if probe_backend(a.probe_timeout, a.probe_budget / 2) is None:
+                    i -= 1
+                    continue
+            break
         sys.stderr.write(proc.stderr)
         if proc.returncode == 0 and proc.stdout.strip():
             # Relay the child's JSON line (last stdout line) verbatim.

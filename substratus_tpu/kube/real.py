@@ -393,15 +393,17 @@ class RealKube(KubeClient):
         # Consecutive WS dial failures poison the forward: raising from
         # here (instead of silently eating them in connection threads)
         # reaches cli/sync.py's retry/backoff exactly like a dead kubectl
-        # subprocess did.
-        self._pf_dial_failures = 0
-        self._pf_last_error: Optional[Exception] = None
+        # subprocess did. The counter is per-forward state (a dict shared
+        # only with this forward's connection threads), not an instance
+        # attribute: two concurrent port_forward calls on one client must
+        # not poison each other's failure counts.
+        pf_state: dict = {"failures": 0, "last_error": None}
         try:
             while not (stop is not None and stop.is_set()):
-                if self._pf_dial_failures >= 3:
+                if pf_state["failures"] >= 3:
                     raise KubeError(
                         f"port-forward to {namespace}/{pod}:{remote_port} "
-                        f"failing: {self._pf_last_error}"
+                        f"failing: {pf_state['last_error']}"
                     )
                 try:
                     conn, _ = listener.accept()
@@ -409,13 +411,15 @@ class RealKube(KubeClient):
                     continue
                 threading.Thread(
                     target=self._forward_one,
-                    args=(namespace, pod, remote_port, conn, stop),
+                    args=(namespace, pod, remote_port, conn, stop, pf_state),
                     daemon=True,
                 ).start()
         finally:
             listener.close()
 
-    def _forward_one(self, namespace, pod, remote_port, conn, stop) -> None:
+    def _forward_one(
+        self, namespace, pod, remote_port, conn, stop, pf_state
+    ) -> None:
         from substratus_tpu.kube.ws import PortForwardStream
 
         log = logging.getLogger(__name__)
@@ -426,13 +430,13 @@ class RealKube(KubeClient):
                 ("portforward.k8s.io",),
             )
         except Exception as e:  # noqa: BLE001 — surfaced via the accept loop
-            self._pf_dial_failures = getattr(self, "_pf_dial_failures", 0) + 1
-            self._pf_last_error = e
+            pf_state["failures"] += 1
+            pf_state["last_error"] = e
             log.warning("port-forward dial %s/%s:%s failed: %s",
                         namespace, pod, remote_port, e)
             conn.close()
             return
-        self._pf_dial_failures = 0
+        pf_state["failures"] = 0
         stream = PortForwardStream(ws)
 
         def pump_out():

@@ -97,9 +97,13 @@ def serve_health(
     if tls:
         ctx = _tls_context()
         if ctx is None:
-            logging.getLogger(__name__).warning(
-                "no TLS backend (cryptography/openssl); metrics port "
-                "serving PLAIN HTTP — bearer tokens cross the wire unencrypted"
+            # Never degrade to plaintext: scraper ServiceAccount bearer
+            # tokens would cross the wire unencrypted. kube-rbac-proxy
+            # refuses to start in the same situation.
+            raise RuntimeError(
+                "tls=True but no TLS backend is available (cryptography "
+                "package or openssl binary required); refusing to serve "
+                "bearer-token-authenticated metrics over plain HTTP"
             )
 
         class Server(http.server.ThreadingHTTPServer):
@@ -107,9 +111,8 @@ def serve_health(
             # never in the accept loop: a client that connects and stalls
             # must not wedge the listener for every later scrape.
             def finish_request(self, request, client_address):
-                if ctx is not None:
-                    request.settimeout(10)
-                    request = ctx.wrap_socket(request, server_side=True)
+                request.settimeout(10)
+                request = ctx.wrap_socket(request, server_side=True)
                 self.RequestHandlerClass(request, client_address, self)
 
             def handle_error(self, request, client_address):
@@ -130,7 +133,7 @@ def _tls_context() -> Optional[ssl.SSLContext]:
     insecureSkipVerify; TLS here is for token confidentiality on the wire,
     matching kube-rbac-proxy's --secure-listen-address). Cert generation
     prefers the `cryptography` package, falls back to the openssl binary,
-    and returns None when neither exists (caller logs and serves HTTP)."""
+    and returns None when neither exists (caller refuses to serve)."""
     pem = _selfsigned_pem()
     if pem is None:
         return None

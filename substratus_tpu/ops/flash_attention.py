@@ -464,7 +464,11 @@ def _cached_kernel(
         m_prev = m_scratch[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        # A row whose attend limit is negative (kv_length==0 padding slot)
+        # masks EVERY column, so m_new stays NEG_INF and exp(s - m_new)
+        # would be exp(0)=1 across the block; clamp those rows to 0 so l
+        # stays 0 and the finalize guard zeroes the output.
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
         alpha = jnp.exp(m_prev - m_new)
         l_scratch[:] = jnp.broadcast_to(
             alpha * l_scratch[:, :1] + jnp.sum(p, axis=-1, keepdims=True),

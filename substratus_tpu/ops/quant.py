@@ -78,15 +78,30 @@ def qeinsum(eq: str, x: jnp.ndarray, w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
         return jnp.einsum(eq, x, materialize(w, dtype))
     ins, out = eq.split("->")
     _, wsub = ins.split(",")
-    shape = [1] * len(out)
     for i, letter in enumerate(wsub):
-        sdim = w.scale.shape[i]
-        if letter in out:
-            shape[out.index(letter)] = sdim
-        elif sdim != 1:
+        if letter not in out and w.scale.shape[i] != 1:
             return jnp.einsum(eq, x, w.dequant(dtype))
     y = jnp.einsum(eq, x, w.q.astype(dtype))
-    return y * w.scale.reshape(shape).astype(dtype)
+    return y * _scale_for_out(w.scale, wsub, out).astype(dtype)
+
+
+def _scale_for_out(scale: jnp.ndarray, opsub: str, out: str) -> jnp.ndarray:
+    """Reshape an operand-indexed scale (contracted dims size-1) so it
+    broadcasts against the einsum output. A plain reshape silently
+    scrambles values when the kept letters are permuted between operand
+    and output (e.g. 'bsd,dhk->bhsk' vs '->bshk'), so transpose the kept
+    dims into output order first when needed."""
+    kept = [i for i, letter in enumerate(opsub) if letter in out]
+    order = sorted(kept, key=lambda i: out.index(opsub[i]))
+    if order != kept:
+        perm = order + [i for i in range(len(opsub)) if i not in kept]
+        scale = jnp.transpose(scale, perm)
+        opsub = "".join(opsub[i] for i in perm)
+    shape = [1] * len(out)
+    for i, letter in enumerate(opsub):
+        if letter in out:
+            shape[out.index(letter)] = scale.shape[i]
+    return scale.reshape(shape)
 
 
 def qeinsum_w8a8(eq: str, x: jnp.ndarray, w: Any,
@@ -122,19 +137,13 @@ def qeinsum_w8a8(eq: str, x: jnp.ndarray, w: Any,
     ).astype(jnp.int8)
     y = jnp.einsum(eq, xq, w.q, preferred_element_type=jnp.int32)
     # Output scale: activation scale broadcasts over x's kept dims (drop
-    # the contracted last axis), weight scale over w's kept dims.
-    shape = [1] * len(out)
-    for i, letter in enumerate(wsub):
-        if letter in out:
-            shape[out.index(letter)] = w.scale.shape[i]
-    a_shape = [1] * len(out)
-    for i, letter in enumerate(xsub[:-1]):
-        if letter in out:
-            a_shape[out.index(letter)] = x.shape[i]
+    # the contracted last axis), weight scale over w's kept dims — both
+    # routed through _scale_for_out so permuted kept letters transpose
+    # rather than silently scramble.
     return (
         y.astype(jnp.float32)
-        * ascale.reshape(a_shape)
-        * w.scale.reshape(shape)
+        * _scale_for_out(ascale, xsub, out)
+        * _scale_for_out(w.scale, wsub, out)
     ).astype(dtype)
 
 

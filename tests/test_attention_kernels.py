@@ -197,3 +197,26 @@ def test_flash_non_divisible_bucket():
     ref = dot_product_attention(q, k, v, causal=True)
     out = flash_attention(q, k, v, True, None, 256, 256, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_cached_attention_zero_length_row():
+    """A row whose cache is entirely empty (kv_length == 0 and position
+    before the cache start) masks every column; its output must be zeros,
+    not a column-mean of V (ADVICE r2: exp(NEG_INF - NEG_INF) == 1)."""
+    from substratus_tpu.ops.flash_attention import flash_cached_attention
+
+    b, sq, h, d, sk = 2, 8, 2, 32, 64
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (b, sq, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, sk, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, sk, d), jnp.float32)
+    kv_len = jnp.array([0, 20], jnp.int32)  # row 0: nothing attendable
+    positions = jnp.stack(
+        [jnp.full((sq,), -1, jnp.int32), 30 + jnp.arange(sq)], axis=0
+    )
+    out = flash_cached_attention(
+        q, k, v, positions, kv_length=kv_len,
+        block_q=8, block_k=32, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out[0]), 0.0, atol=1e-6)
+    assert float(jnp.abs(out[1]).max()) > 0

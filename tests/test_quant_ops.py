@@ -1,0 +1,43 @@
+"""Unit tests for ops/quant.py einsum helpers (beyond the model-level
+parity suites): scale broadcasting must survive kept-dim permutations.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from substratus_tpu.ops.quant import qeinsum, qeinsum_w8a8, quantize
+
+
+@pytest.mark.parametrize("eq", ["bsd,dhk->bshk", "bsd,dhk->bhsk",
+                                "bsd,dhk->bkhs"])
+def test_qeinsum_permuted_output(eq):
+    """ADVICE r2: an equation that permutes kept dims between the weight
+    subscript and the output must transpose the scale, not reshape-scramble
+    it."""
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (2, 3, 16), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (16, 4, 8), jnp.float32)
+    qt = quantize(w, contracting=(0,))
+    ref = jnp.einsum(eq, x, qt.dequant(jnp.float32))
+    out = qeinsum(eq, x, qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_qeinsum_w8a8_permuted_output():
+    key = jax.random.key(2)
+    x = jax.random.normal(key, (2, 3, 16), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (16, 4, 8), jnp.float32)
+    qt = quantize(w, contracting=(0,))
+    base = qeinsum_w8a8("bsd,dhk->bshk", x, qt, jnp.float32)
+    # Sanity: the w8a8 path itself tracks a dequant reference loosely.
+    ref = jnp.einsum("bsd,dhk->bshk", x, qt.dequant(jnp.float32))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(ref),
+                               rtol=0.1, atol=0.1)
+    # Permuted output must be exactly the transposed unpermuted result.
+    out = qeinsum_w8a8("bsd,dhk->bhsk", x, qt, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(base.transpose(0, 2, 1, 3)),
+        rtol=1e-5, atol=1e-5,
+    )

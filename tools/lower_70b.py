@@ -6,9 +6,10 @@ Run standalone (the driver-style proof at v5e-16 scale):
         python tools/lower_70b.py [tensor=16 | data=2,tensor=8]
 Also invoked by tests/test_70b_sharding.py as a subprocess.
 """
+import os
 import sys
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(axes_arg: str = "tensor=16") -> None:

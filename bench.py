@@ -71,14 +71,15 @@ def peak_for(device_kind: str):
     return DEFAULT_PEAK
 
 
-def random_quantized_params(cfg, key):
-    """Random int8 params created quantized (no bf16 transient: a 7B bf16
-    tree would not coexist with its int8 copy in 16G HBM)."""
+def random_quantized_params(cfg, key, quantize="int8"):
+    """Random int8/int4 params created quantized (no bf16 transient: a 7B
+    bf16 tree would not coexist with its quantized copy in 16G HBM)."""
     import jax
     import jax.numpy as jnp
 
     from substratus_tpu.models import llama
     from substratus_tpu.ops.quant import QTensor
+    from substratus_tpu.ops.quant4 import Q4Tensor, _pack_block_for
 
     contracting = llama.quant_contracting(cfg)
     shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k), key)
@@ -89,6 +90,21 @@ def random_quantized_params(cfg, key):
             return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(
                 cfg.dtype
             )
+        if quantize == "int4":
+            contr_n = tuple(sorted(c % len(shape) for c in contr))
+            ax = contr_n[-1]
+            block = _pack_block_for(shape[ax])
+            pshape = tuple(
+                d // 2 if i == ax else d for i, d in enumerate(shape)
+            )
+            sshape = tuple(
+                d // block if i == ax else d for i, d in enumerate(shape)
+            )
+            packed = jax.random.randint(key, pshape, 0, 256, jnp.int32
+                                        ).astype(jnp.uint8)
+            scale = jnp.full(sshape, 0.02 / 7.0, jnp.float32)
+            return Q4Tensor(packed=packed, scale=scale,
+                            pack_axis=ax - len(shape), block=block)
         scale_shape = tuple(
             1 if i in contr else d for i, d in enumerate(shape)
         )
@@ -103,7 +119,8 @@ def random_quantized_params(cfg, key):
     return jax.tree.unflatten(treedef, out)
 
 
-def perf_model(cfg, batch: int, mean_pos: float, kv_itemsize: int):
+def perf_model(cfg, batch: int, mean_pos: float, kv_itemsize: int,
+               quantize: str = "int8"):
     """Decode-step roofline accounting from the real parameter tree.
 
     Returns (flops_per_token, bytes_per_step):
@@ -139,7 +156,10 @@ def perf_model(cfg, batch: int, mean_pos: float, kv_itemsize: int):
             # each step under expert-parallel decode.
             frac = active_frac if len(leaf.shape) == 3 else 1.0
             matmul_flops += 2.0 * n * frac
-            weight_bytes += n * 1 + n / 128.0 * 4  # int8 q + ~per-ch scale
+            if quantize == "int4":
+                weight_bytes += n * 0.5 + n / 128.0 * 4  # nibbles + g128
+            else:
+                weight_bytes += n * 1 + n / 128.0 * 4  # int8 + per-ch scale
         else:
             weight_bytes += n * 2  # bf16 norms/embedding
 
@@ -173,7 +193,7 @@ def run_measurement(
     steps: int = 128,
     config: str = "llama2-7b",
     kv_dtype: str = "int8",
-    w8a8: bool = False,
+    quantize: str = "int8",
 ) -> None:
     """The measured bench body. Runs in the watchdog child; prints the JSON
     line on success, raises on failure."""
@@ -183,10 +203,10 @@ def run_measurement(
     from substratus_tpu.models import llama
 
     cfg = llama.CONFIGS[config]
-    if w8a8:
+    if quantize == "w8a8":
         cfg = cfg.replace(quant_activations=True)
     params = jax.jit(
-        lambda k: random_quantized_params(cfg, k)
+        lambda k: random_quantized_params(cfg, k, quantize)
     )(jax.random.key(0))
     hard_sync(params)
 
@@ -226,13 +246,14 @@ def run_measurement(
     kv_itemsize = 1 if kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize
     mean_pos = pos0 + 1 + steps / 2.0
     flops_per_tok, bytes_per_step = perf_model(
-        cfg, batch, mean_pos, kv_itemsize
+        cfg, batch, mean_pos, kv_itemsize, quantize
     )
     baseline = BASELINES.get(config)
     print(
         json.dumps(
             {
-                "metric": f"{config.replace('-', '_')}_int8_decode_throughput_per_chip",
+                "metric": f"{config.replace('-', '_')}_{quantize}"
+                          "_decode_throughput_per_chip",
                 "value": round(tok_s, 1),
                 "unit": METRIC_UNIT,
                 "vs_baseline": round(tok_s / baseline, 3) if baseline else None,
@@ -249,11 +270,12 @@ def run_measurement(
     )
 
 
-def emit_failure(config: str, error: str) -> None:
+def emit_failure(config: str, error: str, quantize: str = "int8") -> None:
     print(
         json.dumps(
             {
-                "metric": f"{config.replace('-', '_')}_int8_decode_throughput_per_chip",
+                "metric": f"{config.replace('-', '_')}_{quantize}"
+                          "_decode_throughput_per_chip",
                 "value": None,
                 "unit": METRIC_UNIT,
                 "vs_baseline": None,
@@ -333,12 +355,12 @@ def probe_backend(
         delay = min(delay * 2, 300.0)
 
 
-def child_argv(batch, cache_len, steps, config, kv_dtype, w8a8):
+def child_argv(batch, cache_len, steps, config, kv_dtype, quantize):
     return [
         sys.executable, os.path.abspath(__file__), "--child",
         "--batch", str(batch), "--cache-len", str(cache_len),
         "--steps", str(steps), "--config", config, "--kv-dtype", kv_dtype,
-        *(["--w8a8"] if w8a8 else []),
+        "--quantize", quantize,
     ]
 
 
@@ -352,8 +374,14 @@ def main() -> int:
     ap.add_argument("--config", default="llama2-7b")  # validated below
     ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
     ap.add_argument(
+        "--quantize", default="auto",
+        choices=["auto", "int4", "int8", "w8a8"],
+        help="weight quantization; auto = try int4 (the fast path), fall "
+             "back to int8 on ANY failure so a capture always lands",
+    )
+    ap.add_argument(
         "--w8a8", action="store_true",
-        help="dynamic int8 activation quant (s8xs8 MXU matmuls)",
+        help="deprecated alias for --quantize w8a8",
     )
     ap.add_argument(
         "--no-fallback", action="store_true",
@@ -373,10 +401,12 @@ def main() -> int:
         help="hard wall-clock limit per measurement attempt",
     )
     a = ap.parse_args()
+    if a.w8a8:
+        a.quantize = "w8a8"
 
     if a.child:
         run_measurement(a.batch, a.cache_len, a.steps, a.config, a.kv_dtype,
-                        a.w8a8)
+                        "int8" if a.quantize == "auto" else a.quantize)
         return 0
 
     # Validate --config up front (importing the module does not initialize
@@ -389,20 +419,30 @@ def main() -> int:
             f"--config {a.config!r} not in {sorted(llama.CONFIGS)}"
         )
 
+    fail_quant = "int8" if a.quantize == "auto" else a.quantize
+
     err = probe_backend(a.probe_timeout, a.probe_budget)
     if err is not None:
-        emit_failure(a.config, f"backend unavailable: {err}")
+        emit_failure(a.config, f"backend unavailable: {err}", fail_quant)
         return 0
 
-    # Fallback ladder: an out-of-memory on the headline config retries
-    # smaller batches, then a smaller model, so a hardware run always lands
-    # a number. Non-OOM errors terminate the ladder (and still emit JSON).
-    tiers = [
-        (a.batch, a.cache_len, a.config),
-        (max(1, a.batch // 2), a.cache_len, a.config),
-        (max(1, a.batch // 4), max(256, a.cache_len // 2), a.config),
-        (8, 512, "debug-1b"),
-    ]
+    # Fallback ladder, two dimensions:
+    #   * quantize=auto tries int4 first (fastest path) and falls back to
+    #     int8 on ANY failure — a fresh kernel path must never zero the
+    #     round's capture;
+    #   * an out-of-memory retries smaller batches, then a smaller model.
+    # Non-OOM errors on a non-int4 tier terminate the ladder (still
+    # emitting JSON).
+    quant_tiers = ["int4", "int8"] if a.quantize == "auto" else [a.quantize]
+    tiers = []
+    for quant in quant_tiers:
+        tiers += [
+            (a.batch, a.cache_len, a.config, quant),
+            (max(1, a.batch // 2), a.cache_len, a.config, quant),
+            (max(1, a.batch // 4), max(256, a.cache_len // 2), a.config,
+             quant),
+            (8, 512, "debug-1b", quant),
+        ]
     if a.no_fallback:
         tiers = tiers[:1]
     seen = set()
@@ -411,10 +451,11 @@ def main() -> int:
     hang_retry = 1  # one wedge-recovery cycle: re-probe, retry same tier
     i = 0
     while i < len(tiers):
-        batch, cache_len, config = tiers[i]
+        batch, cache_len, config, quant = tiers[i]
+        fail_quant = quant  # label any failure with the tier that produced it
         i += 1
         argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype,
-                          a.w8a8)
+                          quant)
         try:
             proc = subprocess.run(
                 argv, capture_output=True, text=True, timeout=a.run_timeout,
@@ -432,6 +473,17 @@ def main() -> int:
                 if probe_backend(a.probe_timeout, a.probe_budget / 2) is None:
                     i -= 1
                     continue
+            if quant == "int4" and len(quant_tiers) > 1:
+                # The backend is reachable but the int4 path itself hangs
+                # (fresh kernel, unproven lowering): auto mode must still
+                # deliver a number — skip to the int8 tiers.
+                print(
+                    "int4 tier hung; falling back to int8 tiers",
+                    file=sys.stderr, flush=True,
+                )
+                while i < len(tiers) and tiers[i][3] == "int4":
+                    i += 1
+                continue
             break
         sys.stderr.write(proc.stderr)
         if proc.returncode == 0 and proc.stdout.strip():
@@ -443,14 +495,25 @@ def main() -> int:
         # only what gets embedded in the JSON.
         full_err = proc.stderr.strip() or f"rc={proc.returncode}"
         last_err = full_err[-800:]
-        if not looks_oom(full_err):
-            break
-        print(
-            f"bench tier (batch={batch}, cache={cache_len}, "
-            f"config={config}) hit OOM; retrying smaller",
-            file=sys.stderr,
-        )
-    emit_failure(a.config, last_err)
+        if looks_oom(full_err):
+            print(
+                f"bench tier (batch={batch}, cache={cache_len}, "
+                f"config={config}, quant={quant}) hit OOM; retrying smaller",
+                file=sys.stderr,
+            )
+            continue
+        if quant == "int4" and len(quant_tiers) > 1:
+            # Any int4 failure: skip straight to the int8 tiers.
+            print(
+                f"int4 tier failed ({last_err.splitlines()[-1][:160]}); "
+                "falling back to int8",
+                file=sys.stderr,
+            )
+            while i < len(tiers) and tiers[i][3] == "int4":
+                i += 1
+            continue
+        break
+    emit_failure(a.config, last_err, fail_quant)
     return 0
 
 

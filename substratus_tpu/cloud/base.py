@@ -59,10 +59,65 @@ class GCPCloud(Cloud):
     def __init__(self, cfg: Optional[CommonConfig] = None):
         super().__init__(cfg)
         self.project_id = os.environ.get("PROJECT_ID", "")
+        self.cluster_location = os.environ.get("CLUSTER_LOCATION", "")
+
+    def _metadata_get(self, path: str) -> Optional[str]:
+        """One GCE metadata-server value, or None off-GCE / on error.
+        GCE_METADATA_HOST is the standard override (also how tests stub
+        the server). Reference: gcp.go:28-54 via cloud.google.com/go/
+        compute/metadata."""
+        import urllib.error
+        import urllib.request
+
+        host = os.environ.get("GCE_METADATA_HOST", "metadata.google.internal")
+        req = urllib.request.Request(
+            f"http://{host}/computeMetadata/v1/{path}",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                if resp.headers.get("Metadata-Flavor") != "Google":
+                    return None  # some other server squatting the name
+                return resp.read().decode().strip()
+        except (urllib.error.URLError, OSError, TimeoutError):
+            return None
 
     def auto_configure(self) -> None:
-        # In-cluster this would consult the GCE metadata server; env wins.
+        """Fill unset config from the GCE metadata server, then derive the
+        conventional defaults — env always wins (reference gcp.go:28-71:
+        ProjectID, cluster-name, cluster-location from metadata; registry/
+        bucket/principal derived from project)."""
         self.project_id = os.environ.get("PROJECT_ID", self.project_id)
+        if not self.project_id:
+            self.project_id = self._metadata_get("project/project-id") or ""
+        # CommonConfig falls back to "default" when CLUSTER_NAME is unset;
+        # only an explicit env/config value beats the metadata server.
+        if ("CLUSTER_NAME" not in os.environ
+                and self.cfg.cluster_name in ("", "default")):
+            self.cfg.cluster_name = (
+                self._metadata_get("instance/attributes/cluster-name")
+                or self.cfg.cluster_name
+            )
+        if not self.cluster_location:
+            self.cluster_location = (
+                self._metadata_get("instance/attributes/cluster-location")
+                or ""
+            )
+        region = self.cluster_location
+        if region.count("-") == 2:  # zone like us-central1-a -> region
+            region = region.rsplit("-", 1)[0]
+        if not self.cfg.registry_url and self.project_id and region:
+            self.cfg.registry_url = (
+                f"{region}-docker.pkg.dev/{self.project_id}/substratus"
+            )
+        if not self.cfg.artifact_bucket_url and self.project_id:
+            self.cfg.artifact_bucket_url = (
+                f"gs://{self.project_id}-substratus-artifacts"
+            )
+        if not self.cfg.principal and self.project_id:
+            self.cfg.principal = (
+                f"substratus@{self.project_id}.iam.gserviceaccount.com"
+            )
 
     def associate_principal(self, sa_namespace: str, sa_name: str) -> str:
         return (

@@ -113,15 +113,72 @@ def reconcile_service_account(
     return name
 
 
+# Kinds whose spec the apiserver lets us update in place; everything else
+# pod-templated (Job, Pod, JobSet) has immutable fields and must be
+# delete-and-recreated on drift (reference: server_controller.go:264-274
+# SSA-Patches the Deployment; notebook_controller.go:266-281 falls back to
+# delete-and-recreate on immutable-field errors).
+_MUTABLE_KINDS = {"Deployment", "Service", "ConfigMap", "Secret"}
+
+# Sections of a desired child we own and converge. metadata is deliberately
+# excluded (labels/annotations may be written by other controllers).
+_OWNED_SECTIONS = ("spec", "data", "stringData")
+
+
+def _covers(desired: Any, live: Any) -> bool:
+    """True when every field the desired object specifies is present with
+    the same value in live. Dicts compare per-key (apiserver-defaulted
+    extra keys in live are fine), lists positionally and exhaustively
+    (container lists are ordered), scalars by equality."""
+    if isinstance(desired, dict):
+        if not isinstance(live, dict):
+            return False
+        return all(_covers(v, live.get(k)) for k, v in desired.items())
+    if isinstance(desired, list):
+        if not isinstance(live, list) or len(desired) != len(live):
+            return False
+        return all(_covers(d, l) for d, l in zip(desired, live))
+    return desired == live
+
+
+def child_drifted(desired: Obj, live: Obj) -> bool:
+    return any(
+        not _covers(desired[s], live.get(s))
+        for s in _OWNED_SECTIONS
+        if s in desired
+    )
+
+
 def reconcile_child(client: KubeClient, desired: Obj) -> Obj:
-    """Create the child if absent; return live state (reference
-    reconcileJob utils.go:23-35 — create-then-inspect, never mutate)."""
+    """Create the child if absent; converge it when the CR-derived desired
+    state drifts from live (the reference does this with server-side-apply
+    Patches + FieldOwner, falling back to delete-and-recreate for
+    immutable fields — see _MUTABLE_KINDS). Returns live state."""
     kind = desired["kind"]
     md = desired["metadata"]
     try:
-        return client.get(kind, md["namespace"], md["name"])
+        live = client.get(kind, md["namespace"], md["name"])
     except NotFound:
         return client.create(desired)
+    if not child_drifted(desired, live):
+        return live
+    if kind in _MUTABLE_KINDS:
+        for s in _OWNED_SECTIONS:
+            if s not in desired:
+                continue
+            if s == "spec" and isinstance(live.get(s), dict):
+                # Merge per-key: a wholesale replace would clear
+                # apiserver-assigned spec fields (Service clusterIP is
+                # immutable — the PUT would be rejected with "field is
+                # immutable"). data/stringData we own outright.
+                live[s].update(desired[s])
+            else:
+                live[s] = desired[s]
+        return client.update(live)
+    # Immutable (pod-carrying) kinds: recreate. The fake and real clients
+    # both cascade owned objects (Job pods) on delete.
+    client.delete(kind, md["namespace"], md["name"])
+    return client.create(desired)
 
 
 def write_status(client: KubeClient, obj: Obj) -> Obj:

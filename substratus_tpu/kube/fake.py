@@ -119,6 +119,13 @@ class FakeKube(KubeClient):
                         current["metadata"].get("generation", 1) + 1
                     )
                 new["spec"] = copy.deepcopy(obj.get("spec"))
+                # A real apiserver PUT replaces every non-status section —
+                # ConfigMaps/Secrets carry data/stringData, not spec.
+                for k in ("data", "stringData"):
+                    if k in obj:
+                        new[k] = copy.deepcopy(obj[k])
+                    else:
+                        new.pop(k, None)
                 for k in ("labels", "annotations", "ownerReferences"):
                     if k in md:
                         new["metadata"][k] = copy.deepcopy(md[k])

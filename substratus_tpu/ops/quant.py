@@ -52,9 +52,12 @@ def quantize(w: jnp.ndarray, contracting: Sequence[int]) -> QTensor:
 
 
 def materialize(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
-    """QTensor -> dense; dense floating arrays are cast to `dtype` so the
-    matmul dtype policy (bf16 on the MXU) holds regardless of storage dtype."""
-    if isinstance(w, QTensor):
+    """QTensor/Q4Tensor -> dense; dense floating arrays are cast to `dtype`
+    so the matmul dtype policy (bf16 on the MXU) holds regardless of
+    storage dtype."""
+    from substratus_tpu.ops.quant4 import Q4Tensor
+
+    if isinstance(w, (QTensor, Q4Tensor)):
         return w.dequant(dtype)
     if jnp.issubdtype(w.dtype, jnp.floating) and w.dtype != dtype:
         return w.astype(dtype)
@@ -74,6 +77,10 @@ def qeinsum(eq: str, x: jnp.ndarray, w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
     Falls back to dequant-then-dot when the scale varies along a contracted
     dim, and to a plain einsum for dense weights.
     """
+    from substratus_tpu.ops.quant4 import Q4Tensor, q4einsum
+
+    if isinstance(w, Q4Tensor):
+        return q4einsum(eq, x, w, dtype)
     if not isinstance(w, QTensor):
         return jnp.einsum(eq, x, materialize(w, dtype))
     ins, out = eq.split("->")
@@ -120,6 +127,8 @@ def qeinsum_w8a8(eq: str, x: jnp.ndarray, w: Any,
     argmax flips (test_llama_parity::test_w8a8_quant_close).
     """
     if not isinstance(w, QTensor):
+        # Q4Tensor included: int4 group scales vary along the contracted
+        # dim, so s8xs8 scale-after-dot does not apply; weight-only path.
         return qeinsum(eq, x, w, dtype)
     ins, out = eq.split("->")
     xsub, wsub = ins.split(",")
@@ -164,12 +173,15 @@ def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16) -> jnp
 
 
 def is_quantized(params: Any) -> bool:
-    """True if any leaf of the tree is already a QTensor."""
+    """True if any leaf of the tree is already a QTensor/Q4Tensor."""
+    from substratus_tpu.ops.quant4 import Q4Tensor
+
+    kinds = (QTensor, Q4Tensor)
     found = []
     jax.tree.map(
-        lambda x: found.append(True) if isinstance(x, QTensor) else None,
+        lambda x: found.append(True) if isinstance(x, kinds) else None,
         params,
-        is_leaf=lambda x: isinstance(x, QTensor),
+        is_leaf=lambda x: isinstance(x, kinds),
     )
     return bool(found)
 

@@ -124,6 +124,7 @@ def sharding_tree(
     ShapeDtypeStruct pairing).
     """
     from substratus_tpu.ops.quant import QTensor
+    from substratus_tpu.ops.quant4 import Q4Tensor
 
     def fit(shape, spec: P) -> P:
         """Drop spec entries whose mesh-axis size doesn't divide the dim —
@@ -143,6 +144,18 @@ def sharding_tree(
 
     def one(leaf, axes):
         spec = fit(leaf.shape, rules.mesh_axes(axes))
+        if isinstance(leaf, Q4Tensor):
+            # packed halves the pack dim and scale divides it by `block`:
+            # re-fit the weight's spec against each child's real shape so a
+            # mesh axis that no longer divides the dim replicates instead
+            # of erroring (mirrors the QTensor keepdims handling).
+            base = tuple(spec) + (None,) * (leaf.packed.ndim - len(tuple(spec)))
+            return Q4Tensor(
+                packed=NamedSharding(mesh, fit(leaf.packed.shape, P(*base))),
+                scale=NamedSharding(mesh, fit(leaf.scale.shape, P(*base))),
+                pack_axis=leaf.pack_axis,
+                block=leaf.block,
+            )
         if isinstance(leaf, QTensor):
             qspec = tuple(spec) + (None,) * (leaf.q.ndim - len(tuple(spec)))
             sspec = P(
@@ -161,7 +174,7 @@ def sharding_tree(
         one,
         tree,
         logical_tree,
-        is_leaf=lambda x: isinstance(x, QTensor),
+        is_leaf=lambda x: isinstance(x, (QTensor, Q4Tensor)),
     )
 
 

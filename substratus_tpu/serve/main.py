@@ -9,10 +9,11 @@ equivalent:
 
     python -m substratus_tpu.serve.main [--model /content/model] [--port 8080]
 
-Params (from /content/params.json or flags): quantize=int8|w8a8|none
+Params (from /content/params.json or flags): quantize=int8|w8a8|int4|none
 (w8a8 = int8 weights + dynamic per-token int8 activations on the MXU's
-native s8xs8 path), max_batch, max_seq_len, config (named config for
-weightless smoke runs).
+native s8xs8 path; int4 = nibble-packed group-quantized weights, the
+4-bit parity path for the reference's MODEL_LOAD_IN_4BIT / GGUF examples),
+max_batch, max_seq_len, config (named config for weightless smoke runs).
 """
 from __future__ import annotations
 
@@ -42,7 +43,7 @@ def main(argv=None) -> int:
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument(
-        "--quantize", default=None, choices=["int8", "w8a8", "none"]
+        "--quantize", default=None, choices=["int8", "w8a8", "int4", "none"]
     )
     ap.add_argument(
         "--draft-model", default=None,
@@ -117,18 +118,22 @@ def main(argv=None) -> int:
 
     family = registry.module_of(cfg)
 
-    if quantize in ("int8", "w8a8"):
+    if quantize in ("int8", "w8a8", "int4"):
         if family is llama:
             from substratus_tpu.ops.quant import is_quantized, quantize_params
+            from substratus_tpu.ops.quant4 import quantize4_params
 
-            if not is_quantized(params):  # int8 artifacts are pre-quantized
+            if not is_quantized(params):  # quantized artifacts come pre-done
+                qfn = quantize4_params if quantize == "int4" \
+                    else quantize_params
                 params = jax.jit(
-                    lambda p: quantize_params(p, llama.quant_contracting(cfg))
+                    lambda p: qfn(p, llama.quant_contracting(cfg))
                 )(params)
             if quantize == "w8a8":
                 cfg = cfg.replace(quant_activations=True)
         else:
-            print("int8 quantization not supported for this family; skipping")
+            print(f"{quantize} quantization not supported for this family; "
+                  "skipping")
 
     if family is llama:
         # Serving picks its own attention impl (never inherited from
@@ -182,16 +187,17 @@ def main(argv=None) -> int:
         draft_cfg, draft_params = load_checkpoint(draft_dir)
         if registry.module_of(draft_cfg) is not family:
             raise SystemExit("draft model must be the same family as the target")
-        if quantize in ("int8", "w8a8") and family is llama:
+        if quantize in ("int8", "w8a8", "int4") and family is llama:
             from substratus_tpu.ops.quant import is_quantized, quantize_params
+            from substratus_tpu.ops.quant4 import quantize4_params
 
             if not is_quantized(draft_params):
                 # The draft must ride the same quantization as the target —
                 # it exists to cut HBM traffic, not to add bf16 streams.
+                qfn = quantize4_params if quantize == "int4" \
+                    else quantize_params
                 draft_params = jax.jit(
-                    lambda p: quantize_params(
-                        p, llama.quant_contracting(draft_cfg)
-                    )
+                    lambda p: qfn(p, llama.quant_contracting(draft_cfg))
                 )(draft_params)
             if quantize == "w8a8":
                 draft_cfg = draft_cfg.replace(quant_activations=True)

@@ -1,0 +1,87 @@
+"""GCPCloud auto-configuration against a stubbed GCE metadata server
+(reference: internal/cloud/gcp.go:28-71 + gcp_test.go)."""
+import http.server
+import threading
+
+import pytest
+
+from substratus_tpu.cloud.base import GCPCloud
+from substratus_tpu.cloud.common import CommonConfig
+
+
+class _Metadata(http.server.BaseHTTPRequestHandler):
+    VALUES = {
+        "/computeMetadata/v1/project/project-id": "proj-123",
+        "/computeMetadata/v1/instance/attributes/cluster-name": "c1",
+        "/computeMetadata/v1/instance/attributes/cluster-location":
+            "us-central1-a",
+    }
+
+    def do_GET(self):
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_error(403)
+            return
+        value = self.VALUES.get(self.path)
+        if value is None:
+            self.send_error(404)
+            return
+        body = value.encode()
+        self.send_response(200)
+        self.send_header("Metadata-Flavor", "Google")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def metadata_server(monkeypatch):
+    server = http.server.HTTPServer(("127.0.0.1", 0), _Metadata)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    monkeypatch.setenv(
+        "GCE_METADATA_HOST", f"127.0.0.1:{server.server_address[1]}"
+    )
+    monkeypatch.delenv("PROJECT_ID", raising=False)
+    monkeypatch.delenv("CLUSTER_LOCATION", raising=False)
+    yield server
+    server.shutdown()
+
+
+def test_auto_configure_from_metadata(metadata_server):
+    cloud = GCPCloud(CommonConfig())
+    cloud.auto_configure()
+    assert cloud.project_id == "proj-123"
+    assert cloud.cfg.cluster_name == "c1"
+    assert cloud.cluster_location == "us-central1-a"
+    # Derived defaults (zone -> region for the registry).
+    assert cloud.cfg.registry_url == (
+        "us-central1-docker.pkg.dev/proj-123/substratus"
+    )
+    assert cloud.cfg.artifact_bucket_url == "gs://proj-123-substratus-artifacts"
+    assert cloud.cfg.principal == "substratus@proj-123.iam.gserviceaccount.com"
+
+
+def test_env_wins_over_metadata(metadata_server, monkeypatch):
+    monkeypatch.setenv("PROJECT_ID", "env-proj")
+    cloud = GCPCloud(
+        CommonConfig(cluster_name="envcluster", registry_url="r/x",
+                     artifact_bucket_url="gs://b", principal="p@x")
+    )
+    cloud.auto_configure()
+    assert cloud.project_id == "env-proj"
+    assert cloud.cfg.cluster_name == "envcluster"
+    assert cloud.cfg.registry_url == "r/x"
+    assert cloud.cfg.artifact_bucket_url == "gs://b"
+    assert cloud.cfg.principal == "p@x"
+
+
+def test_off_gce_no_hang(monkeypatch):
+    """No metadata server: auto_configure degrades to env-only quickly
+    (a dead host must not hang controller boot)."""
+    monkeypatch.setenv("GCE_METADATA_HOST", "127.0.0.1:1")  # closed port
+    monkeypatch.delenv("PROJECT_ID", raising=False)
+    cloud = GCPCloud(CommonConfig())
+    cloud.auto_configure()
+    assert cloud.project_id == ""

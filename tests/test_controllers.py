@@ -285,3 +285,64 @@ def test_artifact_addressing_stability():
     h2 = object_hash("c", "ns", "Model", "m")
     assert h1 == h2 and len(h1) == 32
     assert h1 != object_hash("c", "ns", "Model", "m2")
+
+
+def test_server_spec_edit_rolls_deployment(env):
+    """Editing a Server's image/params after deploy converges the live
+    Deployment + params ConfigMap (reference: server_controller.go SSA
+    Patch with FieldOwner — spec drift must not be forever)."""
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "srv", "namespace": "default"},
+            "spec": {"image": "img:3", "model": {"name": "base"},
+                     "params": {"quantize": "int8"}},
+        }
+    )
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    mgr.run_until_idle()
+    dep = client.get("Deployment", "default", "srv-server")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "img:3"
+
+    srv = client.get("Server", "default", "srv")
+    srv["spec"]["image"] = "img:4"
+    srv["spec"]["params"] = {"quantize": "int4"}
+    client.update(srv)
+    mgr.run_until_idle()
+
+    dep = client.get("Deployment", "default", "srv-server")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "img:4"
+    cm = client.get("ConfigMap", "default", "srv-server-params")
+    assert "int4" in cm["data"]["params.json"]
+
+
+def test_notebook_spec_edit_recreates_pod(env):
+    """Pod specs are immutable: a Notebook resource/image change must
+    delete-and-recreate the pod (reference: notebook_controller.go:266-281
+    delete-on-immutable-error path)."""
+    client, cloud, sci, mgr = env
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "default"},
+            "spec": {"image": "img:4"},
+        }
+    )
+    mgr.run_until_idle()
+    pod = client.get("Pod", "default", "nb-notebook")
+    first_uid = pod["metadata"]["uid"]
+    assert pod["spec"]["containers"][0]["image"] == "img:4"
+
+    nb = client.get("Notebook", "default", "nb")
+    nb["spec"]["image"] = "img:5"
+    client.update(nb)
+    mgr.run_until_idle()
+
+    pod = client.get("Pod", "default", "nb-notebook")
+    assert pod["spec"]["containers"][0]["image"] == "img:5"
+    assert pod["metadata"]["uid"] != first_uid

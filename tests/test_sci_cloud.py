@@ -1,0 +1,272 @@
+"""Mocked tests for the GCS/S3 SCI backends (sci/backends.py).
+
+The reference gated these behind live credentials (sci/gcp/manager_test.go
+skip gate); here the cloud SDKs are stubbed at the module level so the
+signed-URL parameters, md5 round-trips, and the get-modify-set IAM/IRSA
+merge logic run in CI with no credentials.
+"""
+import base64
+import datetime
+import json
+import sys
+import types
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# GCS
+# ---------------------------------------------------------------------------
+
+
+class _FakeBlob:
+    def __init__(self, name, md5_hash=None):
+        self.name = name
+        self.md5_hash = md5_hash
+        self.signed_kwargs = None
+
+    def generate_signed_url(self, **kw):
+        self.signed_kwargs = kw
+        return f"https://storage.googleapis.com/signed/{self.name}"
+
+
+class _FakeBucket:
+    def __init__(self, blobs):
+        self._blobs = blobs
+
+    def blob(self, name):
+        return self._blobs.setdefault(name, _FakeBlob(name))
+
+    def get_blob(self, name):
+        return self._blobs.get(name)
+
+
+class _FakeStorageClient:
+    def __init__(self, project=None):
+        self.project = project
+        self.blobs = {}
+
+    def bucket(self, name):
+        return _FakeBucket(self.blobs)
+
+
+@pytest.fixture()
+def gcs(monkeypatch):
+    storage = types.ModuleType("google.cloud.storage")
+    storage.Client = _FakeStorageClient
+    google = types.ModuleType("google")
+    cloud = types.ModuleType("google.cloud")
+    cloud.storage = storage
+    google.cloud = cloud
+    monkeypatch.setitem(sys.modules, "google", google)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", storage)
+
+    from substratus_tpu.sci.backends import GCSBackend
+
+    return GCSBackend(project_id="proj-1")
+
+
+def test_gcs_signed_url_params(gcs):
+    md5hex = "0123456789abcdef0123456789abcdef"
+    url = gcs.create_signed_url("gs://bkt/pre", "a/b.tar.gz", md5hex, 300)
+    assert url.startswith("https://storage.googleapis.com/signed/")
+    blob = gcs.client.blobs["pre/a/b.tar.gz"]
+    kw = blob.signed_kwargs
+    assert kw["version"] == "v4"
+    assert kw["method"] == "PUT"
+    assert kw["expiration"] == datetime.timedelta(seconds=300)
+    assert kw["content_md5"] == base64.b64encode(
+        bytes.fromhex(md5hex)
+    ).decode()
+
+
+def test_gcs_md5_roundtrip(gcs):
+    md5hex = "00112233445566778899aabbccddeeff"
+    gcs.client.blobs["obj"] = _FakeBlob(
+        "obj", md5_hash=base64.b64encode(bytes.fromhex(md5hex)).decode()
+    )
+    assert gcs.get_object_md5("gs://bkt", "obj") == md5hex
+    assert gcs.get_object_md5("gs://bkt", "missing") is None
+
+
+class _FakeIAMRequest:
+    def __init__(self, result):
+        self._result = result
+
+    def execute(self):
+        return self._result
+
+
+class _FakeIAMServiceAccounts:
+    def __init__(self, policy):
+        self.policy = policy
+        self.set_calls = []
+
+    def getIamPolicy(self, resource):
+        return _FakeIAMRequest(self.policy)
+
+    def setIamPolicy(self, resource, body):
+        self.set_calls.append((resource, body))
+        self.policy = body["policy"]
+        return _FakeIAMRequest({})
+
+
+@pytest.fixture()
+def gcs_iam(gcs, monkeypatch):
+    sas = _FakeIAMServiceAccounts({"bindings": []})
+    svc = types.SimpleNamespace(
+        projects=lambda: types.SimpleNamespace(
+            serviceAccounts=lambda: sas
+        )
+    )
+    discovery = types.ModuleType("googleapiclient.discovery")
+    discovery.build = lambda *a, **k: svc
+    gac = types.ModuleType("googleapiclient")
+    gac.discovery = discovery
+    monkeypatch.setitem(sys.modules, "googleapiclient", gac)
+    monkeypatch.setitem(sys.modules, "googleapiclient.discovery", discovery)
+    return gcs, sas
+
+
+def test_gcs_bind_identity_get_modify_set(gcs_iam):
+    gcs, sas = gcs_iam
+    member = "serviceAccount:proj-1.svc.id.goog[ns/sa]"
+
+    gcs.bind_identity("gsa@proj-1.iam.gserviceaccount.com", "ns", "sa")
+    assert len(sas.set_calls) == 1
+    binding = sas.policy["bindings"][0]
+    assert binding["role"] == "roles/iam.workloadIdentityUser"
+    assert binding["members"] == [member]
+    resource, _ = sas.set_calls[0]
+    assert resource == (
+        "projects/proj-1/serviceAccounts/"
+        "gsa@proj-1.iam.gserviceaccount.com"
+    )
+
+    # Second KSA appends to the same binding.
+    gcs.bind_identity("gsa@proj-1.iam.gserviceaccount.com", "ns", "sa2")
+    assert sas.policy["bindings"][0]["members"] == [
+        member, "serviceAccount:proj-1.svc.id.goog[ns/sa2]"
+    ]
+
+    # Already-bound is idempotent: no duplicate member.
+    gcs.bind_identity("gsa@proj-1.iam.gserviceaccount.com", "ns", "sa")
+    members = sas.policy["bindings"][0]["members"]
+    assert members.count(member) == 1
+
+
+# ---------------------------------------------------------------------------
+# S3
+# ---------------------------------------------------------------------------
+
+
+class _FakeS3:
+    def __init__(self):
+        self.objects = {}
+        self.presign_calls = []
+
+    def generate_presigned_url(self, op, Params, ExpiresIn):
+        self.presign_calls.append((op, Params, ExpiresIn))
+        return f"https://s3/{Params['Bucket']}/{Params['Key']}?sig=x"
+
+    def head_object(self, Bucket, Key):
+        import botocore.exceptions
+
+        if (Bucket, Key) not in self.objects:
+            raise botocore.exceptions.ClientError(
+                {"Error": {"Code": "404"}}, "HeadObject"
+            )
+        return {"ETag": f'"{self.objects[(Bucket, Key)]}"'}
+
+
+class _FakeIAM:
+    def __init__(self, doc):
+        self.doc = doc
+        self.updates = []
+
+    def get_role(self, RoleName):
+        return {"Role": {"AssumeRolePolicyDocument": self.doc}}
+
+    def update_assume_role_policy(self, RoleName, PolicyDocument):
+        self.updates.append(RoleName)
+        self.doc = json.loads(PolicyDocument)
+
+
+@pytest.fixture()
+def s3(monkeypatch):
+    doc = {
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Action": "sts:AssumeRoleWithWebIdentity",
+                "Condition": {
+                    "StringEquals": {
+                        "oidc.eks.aws/id/ABC:sub":
+                            "system:serviceaccount:ns:existing",
+                    }
+                },
+            }
+        ]
+    }
+    fake_s3, fake_iam = _FakeS3(), _FakeIAM(doc)
+
+    class _ClientError(Exception):
+        def __init__(self, *a, **k):
+            super().__init__("client error")
+
+    boto3 = types.ModuleType("boto3")
+    boto3.client = lambda name: {"s3": fake_s3, "iam": fake_iam}[name]
+    botocore = types.ModuleType("botocore")
+    exceptions = types.ModuleType("botocore.exceptions")
+    exceptions.ClientError = _ClientError
+    botocore.exceptions = exceptions
+    monkeypatch.setitem(sys.modules, "boto3", boto3)
+    monkeypatch.setitem(sys.modules, "botocore", botocore)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", exceptions)
+
+    from substratus_tpu.sci.backends import S3Backend
+
+    backend = S3Backend(oidc_provider_url="https://oidc.eks.aws/id/ABC")
+    return backend, fake_s3, fake_iam
+
+
+def test_s3_presigned_put_params(s3):
+    backend, fake_s3, _ = s3
+    md5hex = "0123456789abcdef0123456789abcdef"
+    url = backend.create_signed_url("s3://bkt/pre", "a.tar.gz", md5hex, 120)
+    assert url.startswith("https://s3/bkt/pre/a.tar.gz")
+    op, params, expires = fake_s3.presign_calls[0]
+    assert op == "put_object"
+    assert params["ContentMD5"] == base64.b64encode(
+        bytes.fromhex(md5hex)
+    ).decode()
+    assert expires == 120
+
+
+def test_s3_etag_as_md5(s3):
+    backend, fake_s3, _ = s3
+    fake_s3.objects[("bkt", "obj")] = "aabbccdd" * 4
+    assert backend.get_object_md5("s3://bkt", "obj") == "aabbccdd" * 4
+    assert backend.get_object_md5("s3://bkt", "missing") is None
+
+
+def test_s3_irsa_trust_merge(s3):
+    backend, _, fake_iam = s3
+    role = "arn:aws:iam::123:role/substratus"
+    backend.bind_identity(role, "ns", "sa")
+    cond = fake_iam.doc["Statement"][0]["Condition"]["StringEquals"]
+    subs = cond["oidc.eks.aws/id/ABC:sub"]
+    # Existing single-string subject promoted to a list + new subject.
+    assert subs == [
+        "system:serviceaccount:ns:existing", "system:serviceaccount:ns:sa"
+    ]
+    # Idempotent re-bind: no duplicates, but the policy write still happens
+    # (matching the reference, which always calls update).
+    backend.bind_identity(role, "ns", "sa")
+    assert subs == fake_iam.doc["Statement"][0]["Condition"]["StringEquals"][
+        "oidc.eks.aws/id/ABC:sub"
+    ]
+    assert fake_iam.doc["Statement"][0]["Condition"]["StringEquals"][
+        "oidc.eks.aws/id/ABC:sub"
+    ].count("system:serviceaccount:ns:sa") == 1

@@ -61,15 +61,35 @@ class GCPCloud(Cloud):
         self.project_id = os.environ.get("PROJECT_ID", "")
         self.cluster_location = os.environ.get("CLUSTER_LOCATION", "")
 
+    _metadata_reachable: Optional[bool] = None
+
     def _metadata_get(self, path: str) -> Optional[str]:
         """One GCE metadata-server value, or None off-GCE / on error.
         GCE_METADATA_HOST is the standard override (also how tests stub
         the server). Reference: gcp.go:28-54 via cloud.google.com/go/
-        compute/metadata."""
+        compute/metadata.
+
+        The first unreachable probe is cached (like metadata.OnGCE()) so
+        off-GCE boot pays one connect attempt, not one per lookup; DNS for
+        the conventional hostname only resolves on that first attempt."""
+        import socket
         import urllib.error
         import urllib.request
 
+        if self._metadata_reachable is False:
+            return None
         host = os.environ.get("GCE_METADATA_HOST", "metadata.google.internal")
+        if self._metadata_reachable is None:
+            try:
+                socket.create_connection(
+                    (host.rsplit(":", 1)[0],
+                     int(host.rsplit(":", 1)[1]) if ":" in host else 80),
+                    timeout=2.0,
+                ).close()
+                self._metadata_reachable = True
+            except OSError:
+                self._metadata_reachable = False
+                return None
         req = urllib.request.Request(
             f"http://{host}/computeMetadata/v1/{path}",
             headers={"Metadata-Flavor": "Google"},

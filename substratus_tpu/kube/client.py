@@ -63,18 +63,35 @@ class KubeClient(ABC):
         except NotFound:
             return None
 
-    def apply(self, obj: Obj) -> Obj:
-        """Server-side-apply-ish upsert: create, or merge spec/metadata onto
-        the existing object (reference client/upload.go:110-124 uses SSA)."""
-        kind, ns, name = obj_key(obj)
-        existing = self.get_or_none(kind, ns, name)
-        if existing is None:
-            return self.create(obj)
-        merged = dict(existing)
-        merged["spec"] = obj.get("spec", existing.get("spec"))
-        md = dict(existing.get("metadata", {}))
-        for k in ("labels", "annotations"):
-            if obj.get("metadata", {}).get(k):
-                md.setdefault(k, {}).update(obj["metadata"][k])
-        merged["metadata"] = md
-        return self.update(merged)
+    def apply(self, obj: Obj, _retries: int = 5) -> Obj:
+        """Server-side-apply-ish upsert: create, or merge spec/metadata
+        onto the existing object (reference client/upload.go:110-124 uses
+        SSA with field ownership).
+
+        Conflict-safe: the merged update carries the read's
+        resourceVersion, so a concurrent writer between our get and update
+        surfaces as a Conflict (optimistic concurrency) and the
+        get-merge-update is retried against the fresh object instead of
+        silently clobbering the other writer (lost update)."""
+        last: Optional[Exception] = None
+        for _ in range(_retries):
+            kind, ns, name = obj_key(obj)
+            existing = self.get_or_none(kind, ns, name)
+            if existing is None:
+                try:
+                    return self.create(obj)
+                except Conflict as e:  # lost a create race; merge instead
+                    last = e
+                    continue
+            merged = dict(existing)
+            merged["spec"] = obj.get("spec", existing.get("spec"))
+            md = dict(existing.get("metadata", {}))
+            for k in ("labels", "annotations"):
+                if obj.get("metadata", {}).get(k):
+                    md.setdefault(k, {}).update(obj["metadata"][k])
+            merged["metadata"] = md
+            try:
+                return self.update(merged)
+            except Conflict as e:
+                last = e
+        raise last if last is not None else KubeError("apply: no attempts")

@@ -246,10 +246,18 @@ def _use_pallas() -> bool:
     try:
         if jax.default_backend() != "tpu":
             return False
+        # Any multi-device process may be GSPMD-sharding the computation
+        # (plain jit + NamedSharding params never enters an abstract-mesh
+        # context, so sharding is invisible at trace time) — and
+        # pallas_call has no SPMD partitioning rule. Only the single-chip
+        # path auto-selects the kernel; sharded serving sets
+        # set_q4_impl("xla") explicitly (serve/main.py) and single-chip
+        # pallas can be forced with set_q4_impl("pallas").
+        if jax.device_count() > 1:
+            return False
     except Exception:  # noqa: BLE001 — backend init failure means no TPU
         return False
-    # Under an ambient mesh the matmul must stay XLA ops so the SPMD
-    # partitioner can shard it; pallas_call has no partitioning rule.
+    # Under an ambient mesh (use_mesh / shard_map tracing) same story.
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is not None and not mesh.empty and mesh.size > 1:
         return False

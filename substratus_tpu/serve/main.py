@@ -34,6 +34,31 @@ def load_params_json(path: str = "/content/params.json") -> Dict[str, Any]:
     return {}
 
 
+def _maybe_quantize(family, cfg, params, quantize: str, quiet: bool = False):
+    """Quantize a (cfg, params) pair per the requested mode. Pre-quantized
+    artifacts pass through; unsupported families keep dense weights."""
+    from substratus_tpu.models import llama
+
+    if quantize not in ("int8", "w8a8", "int4"):
+        return cfg, params
+    if family is not llama:
+        if not quiet:
+            print(f"{quantize} quantization not supported for this family; "
+                  "skipping")
+        return cfg, params
+    from substratus_tpu.ops.quant import is_quantized, quantize_params
+    from substratus_tpu.ops.quant4 import quantize4_params
+
+    if not is_quantized(params):  # quantized artifacts come pre-done
+        qfn = quantize4_params if quantize == "int4" else quantize_params
+        params = jax.jit(
+            lambda p: qfn(p, llama.quant_contracting(cfg))
+        )(params)
+    if quantize == "w8a8":
+        cfg = cfg.replace(quant_activations=True)
+    return cfg, params
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None, help="checkpoint dir (HF or orbax)")
@@ -118,22 +143,7 @@ def main(argv=None) -> int:
 
     family = registry.module_of(cfg)
 
-    if quantize in ("int8", "w8a8", "int4"):
-        if family is llama:
-            from substratus_tpu.ops.quant import is_quantized, quantize_params
-            from substratus_tpu.ops.quant4 import quantize4_params
-
-            if not is_quantized(params):  # quantized artifacts come pre-done
-                qfn = quantize4_params if quantize == "int4" \
-                    else quantize_params
-                params = jax.jit(
-                    lambda p: qfn(p, llama.quant_contracting(cfg))
-                )(params)
-            if quantize == "w8a8":
-                cfg = cfg.replace(quant_activations=True)
-        else:
-            print(f"{quantize} quantization not supported for this family; "
-                  "skipping")
+    cfg, params = _maybe_quantize(family, cfg, params, quantize)
 
     if family is llama:
         # Serving picks its own attention impl (never inherited from
@@ -174,6 +184,13 @@ def main(argv=None) -> int:
         if max_batch % (n_dev // tp):
             ec.max_batch = ((max_batch // (n_dev // tp)) + 1) * (n_dev // tp)
         print(f"serving mesh: data={n_dev // tp} tensor={tp}", flush=True)
+        if quantize == "int4":
+            # Sharded params flow through GSPMD (plain jit + NamedSharding),
+            # which pallas_call cannot partition — pin the SPMD-shardable
+            # XLA lowering for the int4 matmuls (ops/quant4.py).
+            from substratus_tpu.ops.quant4 import set_q4_impl
+
+            set_q4_impl("xla")
     # Speculative decoding: a small draft model (same family) proposes,
     # the target verifies — engine-integrated, batched (serve/engine.py).
     draft = None
@@ -187,20 +204,12 @@ def main(argv=None) -> int:
         draft_cfg, draft_params = load_checkpoint(draft_dir)
         if registry.module_of(draft_cfg) is not family:
             raise SystemExit("draft model must be the same family as the target")
-        if quantize in ("int8", "w8a8", "int4") and family is llama:
-            from substratus_tpu.ops.quant import is_quantized, quantize_params
-            from substratus_tpu.ops.quant4 import quantize4_params
-
-            if not is_quantized(draft_params):
-                # The draft must ride the same quantization as the target —
-                # it exists to cut HBM traffic, not to add bf16 streams.
-                qfn = quantize4_params if quantize == "int4" \
-                    else quantize_params
-                draft_params = jax.jit(
-                    lambda p: qfn(p, llama.quant_contracting(draft_cfg))
-                )(draft_params)
-            if quantize == "w8a8":
-                draft_cfg = draft_cfg.replace(quant_activations=True)
+        # The draft must ride the same quantization as the target — it
+        # exists to cut HBM traffic, not to add bf16 streams.
+        draft_cfg, draft_params = _maybe_quantize(
+            registry.module_of(draft_cfg), draft_cfg, draft_params, quantize,
+            quiet=True,
+        )
         draft = (draft_cfg, draft_params)
         ec.spec_k = spec_k
         print(f"speculative decoding: draft={draft_dir} k={spec_k}", flush=True)

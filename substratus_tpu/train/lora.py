@@ -95,7 +95,15 @@ def merge_lora(
     layers = dict(params["layers"])
     for name, ab in adapters.items():
         orig = layers[name]
-        out_dtype = jnp.bfloat16 if isinstance(orig, QTensor) else orig.dtype
+        from substratus_tpu.ops.quant4 import Q4Tensor
+
+        # Quantized bases (int8 QTensor, int4 Q4Tensor) merge into bf16 —
+        # their own .dtype is the STORAGE dtype (int8/uint8) and casting
+        # the merged float weights to it would destroy the model.
+        out_dtype = (
+            jnp.bfloat16 if isinstance(orig, (QTensor, Q4Tensor))
+            else orig.dtype
+        )
         w = materialize(orig, jnp.float32)
         eq = "ledr,ler...->led..." if ab["a"].ndim == 4 else "ldr,lr...->ld..."
         delta = jnp.einsum(

@@ -346,3 +346,54 @@ def test_notebook_spec_edit_recreates_pod(env):
     pod = client.get("Pod", "default", "nb-notebook")
     assert pod["spec"]["containers"][0]["image"] == "img:5"
     assert pod["metadata"]["uid"] != first_uid
+
+
+def test_apply_conflict_retry_two_writers():
+    """Two writers racing get-merge-update on one object: the loser's
+    stale-resourceVersion update Conflicts and retries against the fresh
+    object — neither write is silently lost (reference: SSA + optimistic
+    concurrency; kube/client.py::apply)."""
+    from substratus_tpu.kube.fake import FakeKube
+
+    client = FakeKube()
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "cm", "namespace": "default",
+                         "labels": {"base": "y"}},
+            "spec": {"v": 0},
+        }
+    )
+
+    # Writer A reads, then B writes (bumping resourceVersion), then A's
+    # update must Conflict internally and retry — keeping B's label.
+    real_get = client.get
+    raced = {"done": False}
+
+    def racing_get(kind, ns, name):
+        obj = real_get(kind, ns, name)
+        if not raced["done"]:
+            raced["done"] = True
+            b = real_get(kind, ns, name)
+            b["metadata"].setdefault("labels", {})["from-b"] = "true"
+            client.update(b)
+        return obj
+
+    client.get = racing_get
+    out = client.apply(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "cm", "namespace": "default",
+                         "labels": {"from-a": "true"}},
+            "spec": {"v": 1},
+        }
+    )
+    client.get = real_get
+
+    live = client.get("ConfigMap", "default", "cm")
+    assert live["spec"] == {"v": 1}                      # A's spec landed
+    assert live["metadata"]["labels"]["from-b"] == "true"  # B's label kept
+    assert live["metadata"]["labels"]["from-a"] == "true"
+    assert out["metadata"]["resourceVersion"] == live["metadata"]["resourceVersion"]

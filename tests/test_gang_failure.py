@@ -1,0 +1,129 @@
+"""The multi-host gang-failure story, end to end (SURVEY.md §5 failure
+detection — TPU spot/maintenance makes this mandatory; the reference never
+had multi-host workloads to lose).
+
+One test walks the whole arc:
+  1. a Model asking for a multi-host TPU slice becomes a JobSet gang with
+     `failurePolicy maxRestarts: 3` (whole-slice recreate on host failure —
+     the JobSet controller's recreate semantics, which we emit config for);
+  2. mid-restart the Model CR tells the truth (ready=False, Complete
+     condition False/JobNotComplete — never falsely Complete);
+  3. the trainer's next incarnation resumes from the last Orbax
+     checkpoint (resumed start_step > 0) rather than step 0;
+  4. when the gang finally completes, the Model goes ready=True.
+"""
+import json
+import os
+
+import pytest
+
+from substratus_tpu.cloud.base import LocalCloud
+from substratus_tpu.cloud.common import CommonConfig
+from substratus_tpu.controller.manager_main import build_manager
+from substratus_tpu.kube.fake import FakeKube
+from substratus_tpu.sci.client import FakeSCIClient
+
+
+@pytest.fixture()
+def env():
+    client = FakeKube()
+    cloud = LocalCloud(
+        CommonConfig(
+            cluster_name="testcluster",
+            artifact_bucket_url="local:///bucket",
+            registry_url="registry.local:5000",
+            principal="test-principal",
+        )
+    )
+    sci = FakeSCIClient()
+    mgr = build_manager(client, cloud, sci)
+    return client, cloud, sci, mgr
+
+
+def _conditions(obj):
+    return {c["type"]: c for c in obj["status"]["conditions"]}
+
+
+def test_gang_failure_restart_resume_story(env, tmp_path, capsys):
+    client, cloud, sci, mgr = env
+
+    # --- 1. multi-host Model -> JobSet gang with restart budget ---------
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Model",
+            "metadata": {"name": "big", "namespace": "default"},
+            "spec": {
+                "image": "img:train",
+                "params": {"steps": 4},
+                "resources": {
+                    "tpu": {"type": "v5e", "chips": 16, "topology": "4x4"}
+                },
+            },
+        }
+    )
+    mgr.run_until_idle()
+
+    js = client.get("JobSet", "default", "big-modeller")
+    assert js["spec"]["failurePolicy"]["maxRestarts"] == 3
+    rj = js["spec"]["replicatedJobs"][0]
+    n_hosts = rj["template"]["spec"]["completions"]
+    assert n_hosts == 4  # 16 chips of v5e = 4 hosts x 4 chips
+    # Headless service for worker discovery exists.
+    svc = client.get("Service", "default", "big-modeller")
+    assert svc["spec"]["clusterIP"] == "None"
+
+    model = client.get("Model", "default", "big")
+    assert model["status"]["ready"] is False
+
+    # --- 2. a host dies; the JobSet controller recreates the slice ------
+    # (whole-slice recreate is the JobSet controller's action; the fake
+    # mirrors its visible status: restarts bumped, no terminal condition).
+    js = client.get("JobSet", "default", "big-modeller")
+    js["status"] = {"restarts": 1, "conditions": []}
+    client.update_status(js)
+    mgr.enqueue("Model", "default", "big")
+    mgr.run_until_idle()
+
+    model = client.get("Model", "default", "big")
+    assert model["status"]["ready"] is False
+    conds = _conditions(model)
+    assert conds["Complete"]["status"] == "False"
+    assert conds["Complete"]["reason"] == "JobNotComplete"
+
+    # --- 3. the restarted trainer resumes from the Orbax checkpoint -----
+    # Run the REAL trainer container entrypoint twice against one
+    # artifacts dir: incarnation 1 checkpoints and "dies" (steps=2);
+    # incarnation 2 (the slice restart) must resume past step 0.
+    from substratus_tpu.train import main as train_main
+
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "corpus.txt").write_text("hello world, substratus tpu! " * 200)
+    out = tmp_path / "artifacts"
+    params = {
+        "config": "tiny", "batch_size": 2, "seq_len": 32,
+        "save_steps": 2, "learning_rate": 1e-3,
+    }
+
+    def run(steps):
+        pfile = tmp_path / "params.json"
+        pfile.write_text(json.dumps({**params, "steps": steps}))
+        rc = train_main.main([
+            "--data", str(data), "--out", str(out), "--params", str(pfile),
+        ])
+        assert rc == 0
+
+    run(steps=2)  # first incarnation: killed after checkpointing step 2
+    capsys.readouterr()
+    run(steps=4)  # slice restart: must resume, not start over
+    stdout = capsys.readouterr().out
+    assert "resumed from step 2" in stdout, stdout
+
+    # --- 4. the gang completes; the CR becomes truthfully ready ---------
+    client.mark_jobset_complete("default", "big-modeller")
+    mgr.enqueue("Model", "default", "big")
+    mgr.run_until_idle()
+    model = client.get("Model", "default", "big")
+    assert model["status"]["ready"] is True
+    assert _conditions(model)["Complete"]["status"] == "True"

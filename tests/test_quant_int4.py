@@ -205,3 +205,21 @@ def test_int4_engine_end_to_end():
         assert got == want, (got, want)
     finally:
         eng.stop()
+
+
+def test_merge_lora_over_int4_base():
+    """merge_lora on a Q4Tensor base must produce bf16 merged weights
+    (Q4's storage dtype is uint8 — casting merged floats to it would
+    destroy the model)."""
+    from substratus_tpu.train import lora as lora_lib
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = quantize4_params(params, llama.quant_contracting(cfg))
+    adapters = lora_lib.init_lora(cfg, jax.random.key(1), rank=2)
+    merged = lora_lib.merge_lora(qparams, adapters, scale=8.0)
+    wq = merged["layers"]["wq"]
+    assert wq.dtype == jnp.bfloat16, wq.dtype
+    # Merged ~= dequantized base + delta: sanity that values are sane.
+    base = qparams["layers"]["wq"].dequant(jnp.float32)
+    assert float(jnp.abs(wq.astype(jnp.float32) - base).mean()) < 1.0

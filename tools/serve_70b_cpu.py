@@ -1,0 +1,116 @@
+"""Execute the north-star 70B serving shardings for real on a virtual mesh.
+
+Runs the ACTUAL Engine — paged KV, chunked prefill, prefix cache, and
+speculative decoding all on — over 16 virtual CPU devices, on a scaled-down
+config that keeps Llama-2-70B's exact axis structure (64 q heads, 8 kv
+heads, GQA group 8 — the tensor>8 regime where kv projections replicate
+while q/mlp shard, engine.py sharding constraint). Greedy tokens must match
+the single-device engine bit-for-bit for every mesh in the matrix:
+
+    tensor=16  and  data=2,tensor=8   (the BASELINE.json v5e-16 layouts)
+
+Usage (also invoked by tests/test_sharded_serving.py as a subprocess):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=16 \
+        python tools/serve_70b_cpu.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from substratus_tpu.utils.jaxenv import honor_requested_platform
+
+    honor_requested_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.parallel.mesh import build_mesh
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    n = len(jax.devices())
+    assert n >= 16, f"need 16 virtual devices, got {n}"
+
+    # 70B axis structure at toy width: H=64, KH=8 (GQA 8), head_dim 8,
+    # mlp 1024 (divides 16), 2 layers. Only dims shrink; every sharding
+    # decision (heads/16, kv replicate-vs-shard, mlp/16, vocab fit) is the
+    # real 70B decision.
+    cfg = llama.CONFIGS["llama2-70b"].replace(
+        dim=512, n_layers=2, head_dim=8, hidden_dim=1024,
+        vocab_size=258, max_seq_len=256, dtype=jnp.float32,
+    )
+    assert cfg.n_heads == 64 and cfg.n_kv_heads == 8
+    params = llama.init_params(cfg, jax.random.key(0))
+    draft_cfg = cfg.replace(n_layers=1)
+    draft_params = llama.init_params(draft_cfg, jax.random.key(1))
+
+    def engine_config():
+        return EngineConfig(
+            max_batch=4,
+            max_seq_len=128,
+            # Prompts longer than this exercise chunked prefill.
+            max_prefill_len=32,
+            eos_token_id=257,
+            kv_layout="paged",
+            page_size=16,
+            prefix_cache=True,
+            spec_k=3,
+        )
+
+    prompts = [
+        [256] + list(range(2, 50)),        # 48 tokens -> 2 prefill chunks
+        [256] + list(range(100, 140)),     # 40 tokens
+        [256, 5, 6, 7],                    # short
+        [256] + list(range(2, 50)),        # shared prefix with prompt 0
+    ]
+
+    def run(mesh=None):
+        eng = Engine(
+            cfg, params, engine_config(), mesh=mesh,
+            draft=(draft_cfg, draft_params),
+        )
+        eng.start()
+        try:
+            return [
+                eng.generate(p, max_tokens=8, temperature=0.0)
+                for p in prompts
+            ]
+        finally:
+            eng.stop()
+
+    print("single-device reference...", flush=True)
+    want = run()
+    assert all(len(t) > 0 for t in want), want
+
+    for axes in ({"tensor": 16}, {"data": 2, "tensor": 8}):
+        print(f"mesh {axes}...", flush=True)
+        mesh = build_mesh(**axes)
+        got = run(mesh)
+        assert got == want, (axes, got, want)
+        # The point of TP: weights are actually sharded over the tensor
+        # axis (q/mlp), kv replicates when tensor > KH.
+        eng = Engine(
+            cfg, params, engine_config(), mesh=mesh,
+            draft=(draft_cfg, draft_params),
+        )
+        wq_spec = str(eng.params["layers"]["wq"].sharding.spec)
+        assert "tensor" in wq_spec, wq_spec
+        tp = axes["tensor"]
+        wk_spec = str(eng.params["layers"]["wk"].sharding.spec)
+        if tp > cfg.n_kv_heads:
+            assert "tensor" not in wk_spec, wk_spec  # replicated, by fit()
+        else:
+            assert "tensor" in wk_spec, wk_spec
+        print(f"mesh {axes}: tokens match single-device; wq={wq_spec}",
+              flush=True)
+
+    print("serve_70b_cpu ok: north-star shardings execute with "
+          "paged KV + chunked prefill + prefix cache + spec decode",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

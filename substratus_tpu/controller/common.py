@@ -2,7 +2,9 @@
 service_accounts_controller.go)."""
 from __future__ import annotations
 
+import copy
 import datetime
+import json
 from typing import Any, Dict, List, Optional
 
 from substratus_tpu.cloud.base import Cloud
@@ -124,61 +126,158 @@ _MUTABLE_KINDS = {"Deployment", "Service", "ConfigMap", "Secret"}
 # excluded (labels/annotations may be written by other controllers).
 _OWNED_SECTIONS = ("spec", "data", "stringData")
 
-
-def _covers(desired: Any, live: Any) -> bool:
-    """True when every field the desired object specifies is present with
-    the same value in live. Dicts compare per-key (apiserver-defaulted
-    extra keys in live are fine), lists positionally and exhaustively
-    (container lists are ordered), scalars by equality."""
-    if isinstance(desired, dict):
-        if not isinstance(live, dict):
-            return False
-        return all(_covers(v, live.get(k)) for k, v in desired.items())
-    if isinstance(desired, list):
-        if not isinstance(live, list) or len(desired) != len(live):
-            return False
-        return all(_covers(d, l) for d, l in zip(desired, live))
-    return desired == live
+# kubectl-style applied-config record. The reference gets field ownership
+# for free from server-side apply with a FieldOwner (server_controller.go:
+# 264-274): fields the owner stops asserting are pruned by the apiserver.
+# Against a plain PUT-based client we reproduce that with the same
+# mechanism `kubectl apply` uses — remember what we last asserted in an
+# annotation and three-way merge (last-applied, desired, live).
+#
+# Only the KEY STRUCTURE is recorded (dicts keep keys, list shapes kept,
+# scalars stripped to null): merge3 never reads last-applied values, and
+# storing values would copy Secret stringData into metadata — the
+# kubectl-apply secret-leak pattern SSA was designed to end — and risk the
+# apiserver's 256KiB annotation budget on big pod templates.
+LAST_APPLIED_ANNOTATION = "substratus.ai/last-applied"
 
 
-def child_drifted(desired: Obj, live: Obj) -> bool:
-    return any(
-        not _covers(desired[s], live.get(s))
-        for s in _OWNED_SECTIONS
-        if s in desired
+def _skeleton(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {k: _skeleton(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_skeleton(x) for x in v]
+    return None
+
+
+def _applied_config(desired: Obj) -> str:
+    return json.dumps(
+        {s: _skeleton(desired[s]) for s in _OWNED_SECTIONS if s in desired},
+        sort_keys=True, separators=(",", ":"),
     )
+
+
+def _last_applied(live: Obj) -> Dict[str, Any]:
+    raw = (
+        live.get("metadata", {}).get("annotations", {})
+        .get(LAST_APPLIED_ANNOTATION)
+    )
+    if not raw:
+        return {}
+    try:
+        out = json.loads(raw)
+    except ValueError:
+        return {}
+    return out if isinstance(out, dict) else {}
+
+
+# k8s strategic-merge identity keys, in patchMergeKey precedence: list
+# elements pair up for an in-place merge only when they agree on the first
+# of these present in either element (containers/env/volumes key on name,
+# Service ports on port, volumeMounts on mountPath, tolerations on key).
+# Dict lists with NO recognized merge key are atomic — exactly what
+# strategic merge does for unkeyed lists.
+_LIST_MERGE_KEYS = ("name", "port", "containerPort", "mountPath", "key")
+
+
+def _same_identity(live_el: Any, desired_el: Any) -> bool:
+    if not (isinstance(live_el, dict) and isinstance(desired_el, dict)):
+        return True  # scalar positions: merge3 takes desired anyway
+    for key in _LIST_MERGE_KEYS:
+        if key in live_el or key in desired_el:
+            return live_el.get(key) == desired_el.get(key)
+    return False
+
+
+def merge3(live: Any, desired: Any, last: Any) -> Any:
+    """Three-way merge of one owned value.
+
+    Dicts: keys desired asserts are set (recursively); keys last-applied
+    asserted that desired no longer does are PRUNED; any other live key
+    (apiserver-owned — Service clusterIP, defaulted fields) is kept.
+    Equal-length lists whose elements pair up by strategic-merge identity
+    (_same_identity) merge elementwise, so apiserver defaults inside
+    container entries survive; a reordered/replaced/resized list is taken
+    from desired atomically — grafting live leftovers onto a *different*
+    element (http's nodePort onto metrics) would be worse than losing a
+    default. Scalars: desired wins."""
+    if isinstance(desired, dict) and isinstance(live, dict):
+        last = last if isinstance(last, dict) else {}
+        out = {k: v for k, v in live.items()
+               if k in desired or k not in last}
+        for k, v in desired.items():
+            out[k] = merge3(out.get(k), v, last.get(k))
+        return out
+    if (
+        isinstance(desired, list)
+        and isinstance(live, list)
+        and len(desired) == len(live)
+        and all(_same_identity(l, d) for l, d in zip(live, desired))
+    ):
+        last = (
+            last if isinstance(last, list) and len(last) == len(desired)
+            else [None] * len(desired)
+        )
+        return [merge3(l, d, la) for l, d, la in zip(live, desired, last)]
+    return copy.deepcopy(desired)
+
+
+def _converged_sections(desired: Obj, live: Obj) -> Dict[str, Any]:
+    """The owned sections live *should* have: three-way merge per section.
+    A section present in last-applied but dropped from desired entirely is
+    merged against an empty assertion — our keys prune, foreign keys stay."""
+    last = _last_applied(live)
+    out: Dict[str, Any] = {}
+    for s in _OWNED_SECTIONS:
+        if s in desired:
+            out[s] = merge3(live.get(s), desired[s], last.get(s))
+        elif s in last and isinstance(live.get(s), dict):
+            out[s] = merge3(live[s], {}, last[s])
+    return out
+
+
+def _stamp(obj: Obj, applied: str) -> Obj:
+    obj.setdefault("metadata", {}).setdefault("annotations", {})[
+        LAST_APPLIED_ANNOTATION
+    ] = applied
+    return obj
 
 
 def reconcile_child(client: KubeClient, desired: Obj) -> Obj:
     """Create the child if absent; converge it when the CR-derived desired
-    state drifts from live (the reference does this with server-side-apply
-    Patches + FieldOwner, falling back to delete-and-recreate for
-    immutable fields — see _MUTABLE_KINDS). Returns live state."""
+    state drifts from live. The reference does this with server-side-apply
+    Patches + FieldOwner (fields the owner stops asserting are pruned by
+    the apiserver — server_controller.go:264-274); here the same semantics
+    come from a last-applied annotation + three-way merge, falling back to
+    delete-and-recreate for immutable kinds (see _MUTABLE_KINDS).
+    Returns live state."""
     kind = desired["kind"]
     md = desired["metadata"]
+    applied = _applied_config(desired)
     try:
         live = client.get(kind, md["namespace"], md["name"])
     except NotFound:
-        return client.create(desired)
-    if not child_drifted(desired, live):
+        return client.create(_stamp(copy.deepcopy(desired), applied))
+    merged = _converged_sections(desired, live)
+    drifted = any(m != live.get(s) for s, m in merged.items())
+    stale_record = (
+        live.get("metadata", {}).get("annotations", {})
+        .get(LAST_APPLIED_ANNOTATION) != applied
+    )
+    if not drifted:
+        if stale_record:
+            # Live already matches, but what we assert changed (a field we
+            # now own already had the right value): record ownership so a
+            # later removal still prunes it. Annotation-only update — legal
+            # even on immutable kinds.
+            live = client.update(_stamp(live, applied))
         return live
     if kind in _MUTABLE_KINDS:
-        for s in _OWNED_SECTIONS:
-            if s not in desired:
-                continue
-            if s == "spec" and isinstance(live.get(s), dict):
-                # Merge per-key: a wholesale replace would clear
-                # apiserver-assigned spec fields (Service clusterIP is
-                # immutable — the PUT would be rejected with "field is
-                # immutable"). data/stringData we own outright.
-                live[s].update(desired[s])
-            else:
-                live[s] = desired[s]
-        return client.update(live)
+        live.update(merged)
+        return client.update(_stamp(live, applied))
     # Immutable (pod-carrying) kinds: recreate. The fake and real clients
     # both cascade owned objects (Job pods) on delete.
     client.delete(kind, md["namespace"], md["name"])
-    return client.create(desired)
+    return client.create(_stamp(copy.deepcopy(desired), applied))
 
 
 def write_status(client: KubeClient, obj: Obj) -> Obj:

@@ -348,6 +348,245 @@ def test_notebook_spec_edit_recreates_pod(env):
     assert pod["metadata"]["uid"] != first_uid
 
 
+def test_server_env_removal_converges(env):
+    """Deleting an env var / param from a Server CR must REMOVE it from the
+    live Deployment — not just stop asserting it (reference: SSA FieldOwner
+    prunes un-asserted fields, server_controller.go:264-274; here the
+    last-applied annotation + three-way merge provides that)."""
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "srv", "namespace": "default"},
+            "spec": {
+                "image": "img:3",
+                "model": {"name": "base"},
+                "env": {"KEEP": "1", "DROP_ME": "2"},
+                "params": {"quantize": "int8", "stale_param": "x"},
+            },
+        }
+    )
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    mgr.run_until_idle()
+    dep = client.get("Deployment", "default", "srv-server")
+    envs = {e["name"] for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert {"KEEP", "DROP_ME", "PARAM_QUANTIZE", "PARAM_STALE_PARAM"} <= envs
+
+    srv = client.get("Server", "default", "srv")
+    del srv["spec"]["env"]["DROP_ME"]
+    del srv["spec"]["params"]["stale_param"]
+    client.update(srv)
+    mgr.run_until_idle()
+
+    dep = client.get("Deployment", "default", "srv-server")
+    envs = {e["name"] for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "DROP_ME" not in envs and "PARAM_STALE_PARAM" not in envs
+    assert "KEEP" in envs and "PARAM_QUANTIZE" in envs
+    cm = client.get("ConfigMap", "default", "srv-server-params")
+    assert "stale_param" not in cm["data"]["params.json"]
+
+
+def test_notebook_resources_removal_converges(env):
+    """Dropping `resources` from a Notebook CR prunes the TPU nodeSelector
+    + resource requests from the (recreated) pod — dict-key removals inside
+    the pod template must converge, not linger."""
+    client, cloud, sci, mgr = env
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "default"},
+            "spec": {
+                "image": "img:4",
+                "resources": {"tpu": {"type": "v5e", "chips": 4}},
+            },
+        }
+    )
+    mgr.run_until_idle()
+    pod = client.get("Pod", "default", "nb-notebook")
+    res = pod["spec"]["containers"][0]["resources"]
+    assert res["requests"]["google.com/tpu"] == "4"
+    assert res["limits"]["google.com/tpu"] == "4"
+
+    nb = client.get("Notebook", "default", "nb")
+    del nb["spec"]["resources"]
+    client.update(nb)
+    mgr.run_until_idle()
+
+    pod = client.get("Pod", "default", "nb-notebook")
+    res = pod["spec"]["containers"][0]["resources"]
+    assert "google.com/tpu" not in res["requests"]
+    assert "google.com/tpu" not in res["limits"]
+
+
+def test_merge3_preserves_apiserver_owned_fields():
+    """The three-way merge prunes only what the controller owned: keys it
+    never asserted (Service clusterIP, apiserver defaults) survive both
+    updates and removals."""
+    from substratus_tpu.controller.common import merge3
+
+    live = {
+        "clusterIP": "10.0.0.7",        # apiserver-assigned, never asserted
+        "selector": {"app": "x"},
+        "ports": [{"port": 8080, "nodePort": 31000}],  # nodePort assigned
+        "sessionAffinity": "None",       # apiserver default
+    }
+    last = {"selector": {"app": "x"}, "ports": [{"port": 8080}],
+            "externalName": "old.example"}
+    desired = {"selector": {"app": "y"}, "ports": [{"port": 8080}]}
+    merged = merge3(live, desired, last)
+    assert merged["clusterIP"] == "10.0.0.7"         # kept: never owned
+    assert merged["sessionAffinity"] == "None"       # kept: never owned
+    assert "externalName" not in merged              # pruned: dropped by owner
+    assert merged["selector"] == {"app": "y"}
+    # same-identity element (port 8080): merge keeps the assigned nodePort
+    assert merged["ports"] == [{"port": 8080, "nodePort": 31000}]
+
+
+def test_merge3_list_identity_guards_against_grafting():
+    """Reordered or replaced list elements must NOT inherit the old
+    element's apiserver-assigned fields (k8s strategic merge keys lists on
+    name/port, never position)."""
+    from substratus_tpu.controller.common import merge3
+
+    # replaced element: port changed -> atomic take of desired, no nodePort
+    merged = merge3(
+        [{"port": 8080, "nodePort": 31000}], [{"port": 9090}], [{"port": 8080}]
+    )
+    assert merged == [{"port": 9090}]
+    # reordered same-length list: swap must not swap the nodePorts
+    live = [
+        {"name": "http", "port": 8080, "nodePort": 31000},
+        {"name": "metrics", "port": 9090, "nodePort": 31001},
+    ]
+    desired = [
+        {"name": "metrics", "port": 9090},
+        {"name": "http", "port": 8080},
+    ]
+    merged = merge3(live, desired, [None, None])
+    assert merged == desired
+    # aligned containers keep defaulted per-element fields
+    merged = merge3(
+        [{"name": "c", "image": "i:1", "imagePullPolicy": "IfNotPresent"}],
+        [{"name": "c", "image": "i:2"}],
+        [{"name": "c", "image": "i:1"}],
+    )
+    assert merged == [
+        {"name": "c", "image": "i:2", "imagePullPolicy": "IfNotPresent"}
+    ]
+    # tolerations key on 'key': a reorder must not graft tolerationSeconds
+    live = [
+        {"key": "a", "operator": "Exists", "tolerationSeconds": 300},
+        {"key": "b", "operator": "Exists"},
+    ]
+    desired = [{"key": "b", "operator": "Exists"},
+               {"key": "a", "operator": "Exists"}]
+    assert merge3(live, desired, None) == desired
+    # dict lists with no recognized merge key are atomic (strategic-merge
+    # semantics for unkeyed lists): no positional grafting
+    live = [{"whenUnsatisfiable": "DoNotSchedule", "maxSkew": 1}]
+    desired = [{"whenUnsatisfiable": "ScheduleAnyway"}]
+    assert merge3(live, desired, None) == desired
+
+
+def test_reconcile_child_adopts_preexisting_unannotated_child():
+    """A child created before last-applied tracking (no annotation) is
+    adopted additively — nothing pruned on the first pass — and stamped so
+    later removals do converge."""
+    from substratus_tpu.controller.common import (
+        LAST_APPLIED_ANNOTATION, reconcile_child,
+    )
+    from substratus_tpu.kube.fake import FakeKube
+
+    client = FakeKube()
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "cm", "namespace": "default"},
+            "data": {"a": "1", "operator-owned?": "unknown"},
+        }
+    )
+    desired = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "cm", "namespace": "default"},
+        "data": {"a": "1"},
+    }
+    live = reconcile_child(client, desired)
+    # no last-applied record existed: the unrecognized key survives
+    assert live["data"]["operator-owned?"] == "unknown"
+    assert live["metadata"]["annotations"][LAST_APPLIED_ANNOTATION]
+    # second pass with the key now recorded as ours -> still kept (we never
+    # asserted it); but a key we DID assert and then drop gets pruned
+    desired["data"] = {"a": "1", "b": "2"}
+    reconcile_child(client, desired)
+    desired["data"] = {"a": "1"}
+    live = reconcile_child(client, desired)
+    assert "b" not in live["data"]
+    assert live["data"]["operator-owned?"] == "unknown"
+
+
+def test_last_applied_records_structure_not_values():
+    """The last-applied annotation stores only key structure — Secret
+    stringData must never be copied into metadata (the kubectl-apply
+    secret-leak pattern server-side apply was designed to end)."""
+    from substratus_tpu.controller.common import (
+        LAST_APPLIED_ANNOTATION, reconcile_child,
+    )
+    from substratus_tpu.kube.fake import FakeKube
+
+    client = FakeKube()
+    live = reconcile_child(client, {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": "creds", "namespace": "default"},
+        "stringData": {"token": "hunter2-SENSITIVE"},
+    })
+    ann = live["metadata"]["annotations"][LAST_APPLIED_ANNOTATION]
+    assert "token" in ann            # structure recorded (enables pruning)
+    assert "hunter2" not in ann      # value never serialized
+    # pruning still works off the structural record
+    live = reconcile_child(client, {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": "creds", "namespace": "default"},
+        "stringData": {"other": "x"},
+    })
+    assert "token" not in live["stringData"]
+
+
+def test_dropping_whole_section_prunes_owned_keys():
+    """Stopping to assert an entire owned section prunes the keys we
+    asserted while keeping foreign writers' keys — and the ownership
+    record is not silently erased along the way."""
+    from substratus_tpu.controller.common import reconcile_child
+    from substratus_tpu.kube.fake import FakeKube
+
+    client = FakeKube()
+    reconcile_child(client, {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "cm", "namespace": "default"},
+        "data": {"ours": "1"},
+    })
+    # another writer adds a key we never asserted
+    cm = client.get("ConfigMap", "default", "cm")
+    cm["data"]["theirs"] = "2"
+    client.update(cm)
+    # new desired state drops the data section entirely
+    live = reconcile_child(client, {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "cm", "namespace": "default"},
+    })
+    assert "ours" not in live.get("data", {})
+    assert live["data"]["theirs"] == "2"
+
+
 def test_apply_conflict_retry_two_writers():
     """Two writers racing get-merge-update on one object: the loser's
     stale-resourceVersion update Conflicts and retries against the fresh

@@ -5,10 +5,12 @@ from __future__ import annotations
 import copy
 import datetime
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from substratus_tpu.cloud.base import Cloud
-from substratus_tpu.kube.client import KubeClient, NotFound, Obj
+from substratus_tpu.kube.client import (
+    KubeClient, NotFound, Obj, fold_secret_string_data,
+)
 from substratus_tpu.sci.client import SCIClient
 
 BOUND_ANNOTATION = "substratus.ai/identity-bound"
@@ -141,11 +143,25 @@ _OWNED_SECTIONS = ("spec", "data", "stringData")
 LAST_APPLIED_ANNOTATION = "substratus.ai/last-applied"
 
 
-def _skeleton(v: Any) -> Any:
+def _skeleton(v: Any, in_list: bool = False) -> Any:
+    """Strip values, keep key structure — EXCEPT the strategic-merge
+    identity fields of list elements (containers[].name, ports[].port, …),
+    which three-way list pruning needs to know WHICH elements we asserted.
+    Identity fields are names/ports by construction, never payload; map
+    values (Secret data included) are always stripped because only list
+    elements get the exemption."""
     if isinstance(v, dict):
-        return {k: _skeleton(x) for k, x in v.items()}
+        return {
+            k: (
+                x
+                if in_list and k in _LIST_MERGE_KEYS
+                and not isinstance(x, (dict, list))
+                else _skeleton(x)
+            )
+            for k, x in v.items()
+        }
     if isinstance(v, list):
-        return [_skeleton(x) for x in v]
+        return [_skeleton(x, in_list=True) for x in v]
     return None
 
 
@@ -179,45 +195,94 @@ def _last_applied(live: Obj) -> Dict[str, Any]:
 _LIST_MERGE_KEYS = ("name", "port", "containerPort", "mountPath", "key")
 
 
-def _same_identity(live_el: Any, desired_el: Any) -> bool:
-    if not (isinstance(live_el, dict) and isinstance(desired_el, dict)):
-        return True  # scalar positions: merge3 takes desired anyway
+def _list_key_field(els: Sequence[Any]) -> Optional[str]:
+    """The strategic-merge key field shared by EVERY dict element of a
+    list (with unique values), or None when the list is not keyable."""
+    if not els or not all(isinstance(e, dict) for e in els):
+        return None
     for key in _LIST_MERGE_KEYS:
-        if key in live_el or key in desired_el:
-            return live_el.get(key) == desired_el.get(key)
-    return False
+        if all(key in e for e in els):
+            vals = [e[key] for e in els]
+            if len(set(map(repr, vals))) == len(vals):
+                return key
+    return None
+
+
+def _merge_keyed_list(live: list, desired: list, last: Any,
+                      key: str) -> list:
+    """Strategic-merge a keyed list: desired elements (in desired order)
+    merge with their key-matched live/last counterparts; live elements the
+    controller never asserted (admission-injected kube-api-access-*
+    volumes, webhook sidecars) are KEPT, appended in live order; live
+    elements previously asserted but dropped from desired are pruned."""
+    live_by = {e[key]: e for e in live if isinstance(e, dict) and key in e}
+    last = last if isinstance(last, list) else []
+    last_by = {e[key]: e for e in last if isinstance(e, dict) and key in e}
+    desired_keys = {e[key] for e in desired}
+    out = [
+        merge3(live_by.get(e[key]), e, last_by.get(e[key])) for e in desired
+    ]
+    for e in live:
+        k = e.get(key) if isinstance(e, dict) else None
+        if k is not None and k not in desired_keys and k not in last_by:
+            out.append(copy.deepcopy(e))  # foreign element: keep
+    return out
+
+
+def _prune_keyed_list(live: list, last: Any) -> list:
+    """live minus the elements our last-applied record asserted (by
+    strategic-merge key). No key field -> the list was ours atomically ->
+    nothing survives."""
+    key = _list_key_field(live)
+    if key is None:
+        return []
+    last = last if isinstance(last, list) else []
+    owned = {e.get(key) for e in last if isinstance(e, dict)}
+    return [copy.deepcopy(e) for e in live
+            if isinstance(e, dict) and e.get(key) not in owned]
 
 
 def merge3(live: Any, desired: Any, last: Any) -> Any:
     """Three-way merge of one owned value.
 
     Dicts: keys desired asserts are set (recursively); keys last-applied
-    asserted that desired no longer does are PRUNED; any other live key
-    (apiserver-owned — Service clusterIP, defaulted fields) is kept.
-    Equal-length lists whose elements pair up by strategic-merge identity
-    (_same_identity) merge elementwise, so apiserver defaults inside
-    container entries survive; a reordered/replaced/resized list is taken
-    from desired atomically — grafting live leftovers onto a *different*
-    element (http's nodePort onto metrics) would be worse than losing a
-    default. Scalars: desired wins."""
+    asserted that desired no longer does are PRUNED — but only the parts
+    we asserted: a nested dict another writer also populated keeps its
+    foreign keys. Any live key we never asserted (Service clusterIP,
+    apiserver defaults) is kept.
+
+    Lists whose elements all carry a strategic-merge key (_LIST_MERGE_KEYS)
+    merge per-element by that key — apiserver defaults inside container
+    entries survive, admission-injected elements are kept, and reorders
+    can't graft one element's assigned fields onto another. Unkeyed lists
+    are atomic (strategic-merge semantics): desired replaces live.
+    Scalars: desired wins."""
     if isinstance(desired, dict) and isinstance(live, dict):
         last = last if isinstance(last, dict) else {}
-        out = {k: v for k, v in live.items()
-               if k in desired or k not in last}
+        out: Dict[str, Any] = {}
+        for k, v in live.items():
+            if k in desired or k not in last:
+                out[k] = v
+            elif isinstance(v, dict):
+                # previously asserted, now dropped: prune only what we
+                # asserted inside it; foreign nested keys survive
+                pruned = merge3(v, {}, last[k])
+                if pruned:
+                    out[k] = pruned
+            elif isinstance(v, list):
+                # dropped keyed list: remove OUR elements, keep foreign
+                # (admission-injected) ones; unkeyed lists were owned
+                # atomically and go entirely
+                kept = _prune_keyed_list(v, last[k])
+                if kept:
+                    out[k] = kept
         for k, v in desired.items():
             out[k] = merge3(out.get(k), v, last.get(k))
         return out
-    if (
-        isinstance(desired, list)
-        and isinstance(live, list)
-        and len(desired) == len(live)
-        and all(_same_identity(l, d) for l, d in zip(live, desired))
-    ):
-        last = (
-            last if isinstance(last, list) and len(last) == len(desired)
-            else [None] * len(desired)
-        )
-        return [merge3(l, d, la) for l, d, la in zip(live, desired, last)]
+    if isinstance(desired, list) and isinstance(live, list):
+        key = _list_key_field(desired)
+        if key is not None and _list_key_field(live) == key:
+            return _merge_keyed_list(live, desired, last, key)
     return copy.deepcopy(desired)
 
 
@@ -242,6 +307,19 @@ def _stamp(obj: Obj, applied: str) -> Obj:
     return obj
 
 
+def _normalize_desired(desired: Obj) -> Obj:
+    """Rewrite desired state into the form the apiserver STORES, so the
+    drift comparison is stable. Today: Secret stringData is write-only —
+    the server folds it into data (base64) and never returns it; asserting
+    stringData verbatim would read as drift on every reconcile, a
+    permanent hot loop. The fold implementation is SHARED with the fake
+    apiserver (kube/client.py::fold_secret_string_data)."""
+    if desired.get("kind") == "Secret" and "stringData" in desired:
+        desired = copy.deepcopy(desired)
+        fold_secret_string_data(desired)
+    return desired
+
+
 def reconcile_child(client: KubeClient, desired: Obj) -> Obj:
     """Create the child if absent; converge it when the CR-derived desired
     state drifts from live. The reference does this with server-side-apply
@@ -250,6 +328,7 @@ def reconcile_child(client: KubeClient, desired: Obj) -> Obj:
     come from a last-applied annotation + three-way merge, falling back to
     delete-and-recreate for immutable kinds (see _MUTABLE_KINDS).
     Returns live state."""
+    desired = _normalize_desired(desired)
     kind = desired["kind"]
     md = desired["metadata"]
     applied = _applied_config(desired)

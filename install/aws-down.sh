@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# EKS teardown (reference: install/scripts/aws-down.sh). Mirrors aws-up.sh:
+# cluster, IRSA policy, ECR repo, artifact bucket.
+set -euo pipefail
+
+: "${AWS_ACCOUNT_ID:?set AWS_ACCOUNT_ID}"
+REGION=${REGION:-us-west-2}
+CLUSTER=${CLUSTER:-substratus}
+BUCKET=${BUCKET:-${AWS_ACCOUNT_ID}-${CLUSTER}-artifacts}
+REPO=${REPO:-${CLUSTER}}
+
+eksctl delete cluster --name "${CLUSTER}" --region "${REGION}" || true
+
+aws iam delete-policy \
+  --policy-arn "arn:aws:iam::${AWS_ACCOUNT_ID}:policy/${CLUSTER}-artifacts" \
+  2>/dev/null || true
+
+aws ecr delete-repository --repository-name "${REPO}" \
+  --region "${REGION}" --force >/dev/null 2>&1 || true
+
+# The artifact bucket holds model/dataset artifacts: refuse to destroy it
+# unless asked (the reference's `aws s3 rb` failed on non-empty buckets
+# anyway — this makes the data-loss step explicit).
+if [ "${DELETE_ARTIFACTS:-no}" = "yes" ]; then
+  aws s3 rb "s3://${BUCKET}" --region "${REGION}" --force || true
+else
+  echo "kept s3://${BUCKET} (set DELETE_ARTIFACTS=yes to remove)"
+fi

@@ -1,0 +1,77 @@
+#!/usr/bin/env bash
+# EKS bring-up (reference: install/scripts/aws-up.sh — EKS + S3 + ECR +
+# karpenter GPU pools). TPUs are a GCP-only accelerator, so the AWS stack
+# here is operator + data/CPU-serving parity: the controllers, the S3 SCI
+# backend (IRSA-authenticated signed URLs), dataset loads and CPU model
+# serving all run on EKS; Model training/serving CRs that ask for
+# `resources.tpu` park with an explanatory condition until scheduled on a
+# GKE cluster. The reference's karpenter+nvidia-device-plugin GPU pools
+# have no TPU analogue on AWS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${AWS_ACCOUNT_ID:?set AWS_ACCOUNT_ID}"
+REGION=${REGION:-us-west-2}
+CLUSTER=${CLUSTER:-substratus}
+BUCKET=${BUCKET:-${AWS_ACCOUNT_ID}-${CLUSTER}-artifacts}
+REPO=${REPO:-${CLUSTER}}
+
+# Artifact bucket + image repository (md5-addressed artifacts land here;
+# see cloud/ and sci/ S3 backends).
+aws s3 mb "s3://${BUCKET}" --region "${REGION}" 2>/dev/null || true
+aws ecr create-repository --repository-name "${REPO}" \
+  --region "${REGION}" >/dev/null 2>&1 || true
+
+# Cluster: managed CPU node group; OIDC enabled for IRSA (the S3 SCI
+# server exchanges its ServiceAccount for the role below — sci/ S3
+# backend's get-modify-set trust-policy flow).
+eksctl create cluster \
+  --name "${CLUSTER}" --region "${REGION}" \
+  --with-oidc \
+  --node-type m6i.xlarge \
+  --nodes 1 --nodes-min 1 --nodes-max 4 \
+  || eksctl upgrade cluster --name "${CLUSTER}" --region "${REGION}"
+
+# IRSA role for the SCI server + workload SAs (bucket-scoped).
+cat > /tmp/substratus-s3-policy.json <<EOF
+{
+  "Version": "2012-10-17",
+  "Statement": [{
+    "Effect": "Allow",
+    "Action": ["s3:GetObject", "s3:PutObject", "s3:ListBucket"],
+    "Resource": [
+      "arn:aws:s3:::${BUCKET}",
+      "arn:aws:s3:::${BUCKET}/*"
+    ]
+  }]
+}
+EOF
+aws iam create-policy \
+  --policy-name "${CLUSTER}-artifacts" \
+  --policy-document file:///tmp/substratus-s3-policy.json \
+  >/dev/null 2>&1 || true
+eksctl create iamserviceaccount \
+  --cluster "${CLUSTER}" --region "${REGION}" \
+  --namespace substratus --name sci \
+  --attach-policy-arn "arn:aws:iam::${AWS_ACCOUNT_ID}:policy/${CLUSTER}-artifacts" \
+  --approve || true
+
+# JobSet controller (the gang primitive; harmless on CPU-only clusters,
+# required if this kubeconfig is ever pointed at TPU pools).
+kubectl apply --server-side -f \
+  https://github.com/kubernetes-sigs/jobset/releases/latest/download/manifests.yaml
+
+make install-manifests
+kubectl apply -f install/substratus-tpu.yaml
+kubectl create configmap system -n substratus \
+  --from-literal=CLOUD=aws \
+  --from-literal=CLUSTER_NAME="${CLUSTER}" \
+  --from-literal=REGION="${REGION}" \
+  --from-literal=ARTIFACT_BUCKET_URL="s3://${BUCKET}" \
+  --from-literal=REGISTRY_URL="${AWS_ACCOUNT_ID}.dkr.ecr.${REGION}.amazonaws.com/${REPO}" \
+  --from-literal=PRINCIPAL="arn:aws:iam::${AWS_ACCOUNT_ID}:role/${CLUSTER}-artifacts" \
+  --from-literal=SCI_BACKEND=s3 \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "EKS cluster '${CLUSTER}' ready (operator + S3/IRSA; TPU asks park" \
+     "until pointed at a GKE TPU cluster — see docs/troubleshooting.md)"

@@ -262,6 +262,40 @@ def update_cache_and_attend(
     kkT = kk.transpose(0, 2, 1, 3)  # [B, KH, S, D]
     vvT = vv.transpose(0, 2, 1, 3)
     quantized = "k_scale" in layer_cache
+
+    if s == 1 and kv_length is None and impl == "fused":
+        # Flash-decode: the k/v scatter happens INSIDE the kernel (one
+        # dispatch, no HBM re-read of the fresh row); only the tiny
+        # [B, KH] scale scatters stay in XLA where they fuse with the
+        # projections (ops/fused_decode.py).
+        from substratus_tpu.ops.fused_decode import fused_decode_attention
+
+        kv_out = {}
+        if quantized:
+            kq, kscale = quantize_kv(kkT)
+            vq, vscale = quantize_kv(vvT)
+            kv_out["k_scale"] = (
+                layer_cache["k_scale"].at[bidx, hidx, sidx]
+                .set(kscale[..., 0])
+            )
+            kv_out["v_scale"] = (
+                layer_cache["v_scale"].at[bidx, hidx, sidx]
+                .set(vscale[..., 0])
+            )
+            attn, kv_out["k"], kv_out["v"] = fused_decode_attention(
+                q, kq, vq, layer_cache["k"], layer_cache["v"],
+                positions[:, 0], kscale[..., 0], vscale[..., 0],
+                kv_out["k_scale"], kv_out["v_scale"],
+            )
+        else:
+            attn, kv_out["k"], kv_out["v"] = fused_decode_attention(
+                q,
+                kkT.astype(layer_cache["k"].dtype),
+                vvT.astype(layer_cache["v"].dtype),
+                layer_cache["k"], layer_cache["v"], positions[:, 0],
+            )
+        return attn, kv_out
+
     kv_out = {}
     if quantized:
         kq, kscale = quantize_kv(kkT)  # scale [B, KH, S, 1]

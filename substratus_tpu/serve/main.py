@@ -34,6 +34,24 @@ def load_params_json(path: str = "/content/params.json") -> Dict[str, Any]:
     return {}
 
 
+def resolve_kv_layout(params_json: Dict[str, Any]) -> str:
+    """The decode_attn_impl="fused" kernel lives on the DENSE slot-cache
+    path (update_cache_and_attend); paged decode has its own read path
+    and never reaches it. Asking for fused with layout auto therefore
+    resolves to dense — and asking for fused WITH paged is a config
+    contradiction, rejected loudly rather than silently serving unfused."""
+    layout = params_json.get("kv_layout", "auto")
+    fused = params_json.get("decode_attn_impl") == "fused"
+    if fused and layout == "auto":
+        return "dense"
+    if fused and layout == "paged":
+        raise SystemExit(
+            "params.json: decode_attn_impl=fused requires kv_layout=dense "
+            "(the paged decode path does not use the fused kernel)"
+        )
+    return layout
+
+
 def _maybe_quantize(family, cfg, params, quantize: str, quiet: bool = False):
     """Quantize a (cfg, params) pair per the requested mode. Pre-quantized
     artifacts pass through; unsupported families keep dense weights."""
@@ -95,8 +113,8 @@ def main(argv=None) -> int:
         params_json,
         (
             "model", "config", "quantize", "max_batch", "max_seq_len",
-            "max_prefill_len", "kv_cache_dtype", "attn_impl",
-            "chunk_attn_impl", "tensor",
+            "max_prefill_len", "kv_cache_dtype", "kv_layout", "attn_impl",
+            "chunk_attn_impl", "decode_attn_impl", "tensor",
             "replicas", "draft_model", "spec_k",
         ),
         "serve.main",
@@ -145,6 +163,7 @@ def main(argv=None) -> int:
 
     cfg, params = _maybe_quantize(family, cfg, params, quantize)
 
+    kv_layout = resolve_kv_layout(params_json)
     if family is llama:
         # Serving picks its own attention impl (never inherited from
         # training). On TPU the Pallas flash kernel is the prefill default
@@ -159,6 +178,11 @@ def main(argv=None) -> int:
             # lowering has not yet run on a chip (tunnel wedged before the
             # validation completed) — opt-in until it has.
             chunk_attn_impl=params_json.get("chunk_attn_impl", "xla"),
+            # "fused" = flash-decode (scatter+attention in one kernel,
+            # ops/fused_decode.py); opt-in until on-chip numbers land,
+            # same policy as the chunk kernel above. Lives on the dense
+            # slot-cache path — resolve_kv_layout picks/polices the layout.
+            decode_attn_impl=params_json.get("decode_attn_impl", "xla"),
         )
 
     ec = EngineConfig(
@@ -169,6 +193,7 @@ def main(argv=None) -> int:
         ),
         eos_token_id=tokenizer.eos_id if tokenizer.eos_id is not None else 2,
         kv_cache_dtype=params_json.get("kv_cache_dtype", "model"),
+        kv_layout=kv_layout,
     )
     # Multi-chip serving: tensor-parallel over as many chips as the kv heads
     # allow (params.json {"tensor": N} overrides), data-parallel the rest.

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Dev loop against a GKE cluster (reference: skaffold.gcp.yaml:1-20 —
+# build the manager+SCI images, push to the project registry, redeploy).
+#
+#   hack/dev-gcp.sh           # one build-push-restart cycle
+#   hack/dev-gcp.sh --watch   # re-run the cycle whenever sources change
+#
+# Assumes install/gcp-up.sh has run (cluster + system ConfigMap exist).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PROJECT=${PROJECT:-$(gcloud config get-value project 2>/dev/null)}
+[ -n "$PROJECT" ] || { echo "set PROJECT (no gcloud default project)" >&2; exit 1; }
+REGISTRY=${REGISTRY:-gcr.io/${PROJECT}/substratus}
+TAG=${TAG:-dev-$(git rev-parse --short HEAD 2>/dev/null || echo local)}
+IMAGE="$REGISTRY/runtime:$TAG"
+
+cycle() {
+  docker build -t "$IMAGE" .
+  docker push "$IMAGE"
+  kubectl set image -n substratus deployment/controller-manager "manager=$IMAGE"
+  kubectl set image -n substratus deployment/sci "sci=$IMAGE"
+  kubectl rollout status -n substratus deployment/controller-manager --timeout=180s
+  kubectl rollout status -n substratus deployment/sci --timeout=180s
+}
+
+cycle
+[ "${1:-}" = "--watch" ] || exit 0
+
+echo "watching substratus_tpu/ for changes..."
+last=$(find substratus_tpu native Dockerfile -type f -exec stat -c %Y {} + | sort -n | tail -1)
+while sleep 2; do
+  now=$(find substratus_tpu native Dockerfile -type f -exec stat -c %Y {} + | sort -n | tail -1)
+  if [ "$now" != "$last" ]; then
+    last=$now
+    echo "change detected; rebuilding"
+    cycle || echo "cycle failed; will retry on next change"
+  fi
+done

@@ -1,0 +1,215 @@
+"""`sub chat` — interactive chat against a served model (reference:
+internal/tui/infer_chat.go — the bubbletea chat surface wired to a
+served endpoint; dead code upstream behind the commented-out `infer`
+command at internal/cli/root.go:19, implemented here as a live command).
+
+Talks the OpenAI chat API the serving engine exposes
+(POST /v1/chat/completions with stream=true, SSE chunks), so the same
+REPL works against `sub serve`, a Server CR behind a port-forward, or
+any OpenAI-compatible endpoint.
+
+Endpoint resolution:
+  sub chat --url http://localhost:8080      # direct (local `sub serve`)
+  sub chat srv                              # Server CR: resolve the
+      -server pod and port-forward :8080 through the apiserver
+      (kube/ws.py portforward.k8s.io streams), then chat over loopback.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+from typing import List, Optional
+
+
+ANSI_USER = "\x1b[36m"     # cyan
+ANSI_MODEL = "\x1b[32m"    # green
+ANSI_DIM = "\x1b[2m"
+ANSI_RESET = "\x1b[0m"
+
+
+def _color(enabled: bool, code: str) -> str:
+    return code if enabled else ""
+
+
+def stream_chat(
+    url: str,
+    messages: List[dict],
+    *,
+    max_tokens: int = 256,
+    temperature: float = 0.7,
+    timeout: float = 300.0,
+):
+    """POST /v1/chat/completions stream=true; yields content deltas."""
+    body = json.dumps(
+        {
+            "messages": messages,
+            "max_tokens": max_tokens,
+            "temperature": temperature,
+            "stream": True,
+        }
+    ).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/chat/completions",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for raw in resp:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[len("data:"):].strip()
+            if payload == "[DONE]":
+                return
+            try:
+                chunk = json.loads(payload)
+            except ValueError:
+                continue
+            for choice in chunk.get("choices", []):
+                delta = choice.get("delta", {}).get("content")
+                if delta:
+                    yield delta
+
+
+def repl(
+    url: str,
+    *,
+    stdin=None,
+    stdout=None,
+    max_tokens: int = 256,
+    temperature: float = 0.7,
+    system: Optional[str] = None,
+    color: Optional[bool] = None,
+) -> int:
+    """The chat loop. Plain readline REPL (works over any terminal or
+    pty; /quit or EOF exits, /reset clears the conversation)."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    if color is None:
+        color = getattr(stdout, "isatty", lambda: False)()
+    messages: List[dict] = []
+    if system:
+        messages.append({"role": "system", "content": system})
+
+    stdout.write(
+        f"{_color(color, ANSI_DIM)}chatting with {url} — /quit to exit, "
+        f"/reset to clear history{_color(color, ANSI_RESET)}\n"
+    )
+    stdout.flush()
+    while True:
+        stdout.write(f"{_color(color, ANSI_USER)}you>{_color(color, ANSI_RESET)} ")
+        stdout.flush()
+        try:
+            line = stdin.readline()
+        except KeyboardInterrupt:
+            # ctrl-c at the prompt is the normal way out of an
+            # interactive tool — exit cleanly, no traceback
+            stdout.write("\n")
+            return 0
+        if not line:
+            stdout.write("\n")
+            return 0
+        line = line.strip()
+        if not line:
+            continue
+        if line in ("/quit", "/exit"):
+            return 0
+        if line == "/reset":
+            messages = [m for m in messages if m["role"] == "system"]
+            stdout.write(
+                f"{_color(color, ANSI_DIM)}(history cleared)"
+                f"{_color(color, ANSI_RESET)}\n"
+            )
+            continue
+        messages.append({"role": "user", "content": line})
+        stdout.write(
+            f"{_color(color, ANSI_MODEL)}model>{_color(color, ANSI_RESET)} "
+        )
+        stdout.flush()
+        reply = []
+        try:
+            for delta in stream_chat(
+                url, messages, max_tokens=max_tokens, temperature=temperature
+            ):
+                reply.append(delta)
+                stdout.write(delta)
+                stdout.flush()
+        except KeyboardInterrupt:
+            stdout.write(
+                f"\n{_color(color, ANSI_DIM)}(interrupted)"
+                f"{_color(color, ANSI_RESET)}"
+            )
+        except OSError as e:
+            stdout.write(
+                f"\n{_color(color, ANSI_DIM)}request failed: {e}"
+                f"{_color(color, ANSI_RESET)}\n"
+            )
+            messages.pop()  # request never answered; keep history clean
+            continue
+        stdout.write("\n")
+        stdout.flush()
+        messages.append({"role": "assistant", "content": "".join(reply)})
+
+
+def run_chat(args) -> int:
+    # --plain forces uncolored output (the REPL is line-based either way)
+    color = False if getattr(args, "plain", False) else None
+    if args.url:
+        return repl(
+            args.url,
+            max_tokens=args.max_tokens,
+            temperature=args.temperature,
+            system=args.system,
+            color=color,
+        )
+    if not args.name:
+        raise SystemExit("sub chat: give a Server name or --url")
+    # Server CR path: find the -server pod, port-forward 8080, chat over
+    # loopback (same machinery as `sub notebook`'s forward).
+    import threading
+
+    from substratus_tpu.cli import commands
+    from substratus_tpu.cli.sync import port_forward
+
+    client = commands._client(args)
+    ns = getattr(args, "namespace", "default") or "default"
+    server = client.get("Server", ns, args.name)
+    del server
+    pods = [
+        p for p in client.list("Pod", ns)
+        if p["metadata"].get("labels", {}).get("substratus.ai/object")
+        == f"server-{args.name}"
+        and p.get("status", {}).get("phase") == "Running"
+    ]
+    if not pods:
+        raise SystemExit(
+            f"no running pod for server {args.name!r} (is it Ready?)"
+        )
+    pod = pods[0]["metadata"]["name"]
+    local_port = args.local_port
+    t = threading.Thread(
+        target=port_forward, args=(client, ns, pod, local_port, 8080),
+        daemon=True,
+    )
+    t.start()
+    # Wait for the forward to round-trip before the first request — the
+    # local listener accepts before any pod-side stream exists
+    # (cli/sync.py::_probe_forward; same wait the notebook loop does).
+    from substratus_tpu.cli.sync import _probe_forward
+
+    for _ in range(60):
+        if not t.is_alive():
+            raise SystemExit("port-forward failed — `sub logs server "
+                             f"{args.name}` for the pod side")
+        if _probe_forward(local_port):
+            break
+        time.sleep(0.5)
+    return repl(
+        f"http://127.0.0.1:{local_port}",
+        max_tokens=args.max_tokens,
+        temperature=args.temperature,
+        system=args.system,
+        color=color,
+    )

@@ -370,6 +370,13 @@ def cmd_serve(args) -> int:
     return serve_main(argv)
 
 
+def cmd_chat(args) -> int:
+    """Interactive chat REPL (reference tui/infer_chat.go)."""
+    from substratus_tpu.cli.chat import run_chat
+
+    return run_chat(args)
+
+
 def cmd_notebook(args) -> int:
     from substratus_tpu.cli import tui
 
@@ -527,6 +534,22 @@ def register(sub) -> None:
     p.add_argument("--config")
     p.add_argument("--port", type=int, default=8080)
     p.set_defaults(func=cmd_serve)
+
+    p = sub.add_parser(
+        "chat", help="interactive chat with a served model"
+    )
+    p.add_argument("name", nargs="?", help="Server CR name (port-forwards)")
+    p.add_argument("--url", help="direct endpoint (e.g. http://localhost:8080)")
+    p.add_argument("--max-tokens", type=int, default=256)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--system", help="system prompt")
+    p.add_argument("--local-port", type=int, default=18080)
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--fake", action="store_true",
+                   help="in-process fake cluster (local dev)")
+    p.add_argument("--plain", action="store_true",
+                   help="uncolored output")
+    p.set_defaults(func=cmd_chat)
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(func=cmd_version)

@@ -72,8 +72,12 @@ def _kernel(
     nblk = (pos + bs - 1) // bs  # history blocks (cols < pos), dynamic
 
     # Fresh-row writeback: straight from the VMEM operands into the HBM
-    # slot. No ordering hazard with the history reads below — they mask
-    # strictly below pos.
+    # slot. COMPLETED before any history read starts: when pos % bs != 0
+    # the last history block covers row pos, and although that row is
+    # masked to probability zero, a torn concurrent read could decode as
+    # NaN and 0 * NaN would poison the p@V accumulation. The row is one
+    # [1, D] burst, so serializing it ahead of the (much larger) history
+    # stream costs nothing measurable.
     wk = pltpu.make_async_copy(
         nk_ref.at[0, 0], cko_ref.at[ib, ih, pl.ds(pos, 1), :], wsem.at[0]
     )
@@ -82,6 +86,8 @@ def _kernel(
     )
     wk.start()
     wv.start()
+    wk.wait()
+    wv.wait()
 
     def dma_k(i, slot):
         return pltpu.make_async_copy(
@@ -185,9 +191,6 @@ def _kernel(
         p = p * nvs_ref[0, 0]
     acc = acc * alpha + p * vf
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-
-    wk.wait()
-    wv.wait()
 
 
 def _pad_groups(q: jnp.ndarray, kh: int) -> Tuple[jnp.ndarray, int, int]:

@@ -164,6 +164,14 @@ def main(argv=None) -> int:
     cfg, params = _maybe_quantize(family, cfg, params, quantize)
 
     kv_layout = resolve_kv_layout(params_json)
+    if family is not llama and params_json.get("decode_attn_impl"):
+        # Same loud-not-silent policy as resolve_kv_layout and
+        # _maybe_quantize: the knob only exists on the llama family.
+        print(
+            f"decode_attn_impl ignored: {type(cfg).__name__} has no "
+            "decode attention implementation switch",
+            flush=True,
+        )
     if family is llama:
         # Serving picks its own attention impl (never inherited from
         # training). On TPU the Pallas flash kernel is the prefill default

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int manifests protogen nbwatch bench graft image install-manifests
+.PHONY: test test-int manifests api-docs protogen nbwatch bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -15,6 +15,9 @@ test-int:
 
 manifests:
 	$(PY) -m substratus_tpu.api.crdgen > config/crd/substratus-crds.yaml
+
+api-docs:
+	$(PY) -m substratus_tpu.api.docgen > docs/api.md
 
 protogen:
 	protoc --python_out=substratus_tpu/sci --proto_path=substratus_tpu/sci \

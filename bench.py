@@ -194,6 +194,7 @@ def run_measurement(
     config: str = "llama2-7b",
     kv_dtype: str = "int8",
     quantize: str = "int8",
+    decode_impl: str = "xla",
 ) -> None:
     """The measured bench body. Runs in the watchdog child; prints the JSON
     line on success, raises on failure."""
@@ -205,6 +206,11 @@ def run_measurement(
     cfg = llama.CONFIGS[config]
     if quantize == "w8a8":
         cfg = cfg.replace(quant_activations=True)
+    if decode_impl != "xla":
+        # "fused" = flash-decode (ops/fused_decode.py: in-kernel cache
+        # write + dynamic-length history stream); "pallas" = the unfused
+        # Pallas attention kernel.
+        cfg = cfg.replace(decode_attn_impl=decode_impl)
     params = jax.jit(
         lambda k: random_quantized_params(cfg, k, quantize)
     )(jax.random.key(0))
@@ -264,6 +270,7 @@ def run_measurement(
                 ),
                 "batch": batch,
                 "cache_len": cache_len,
+                "decode_impl": decode_impl,
                 "device": getattr(device, "device_kind", str(device)),
             }
         )
@@ -441,12 +448,13 @@ def probe_backend(
         delay = min(delay * 2, 300.0)
 
 
-def child_argv(batch, cache_len, steps, config, kv_dtype, quantize):
+def child_argv(batch, cache_len, steps, config, kv_dtype, quantize,
+               decode_impl="xla"):
     return [
         sys.executable, os.path.abspath(__file__), "--child",
         "--batch", str(batch), "--cache-len", str(cache_len),
         "--steps", str(steps), "--config", config, "--kv-dtype", kv_dtype,
-        "--quantize", quantize,
+        "--quantize", quantize, "--decode-impl", decode_impl,
     ]
 
 
@@ -477,6 +485,12 @@ def main() -> int:
         "--child", action="store_true",
         help="internal: run the measurement in-process (watchdog target)",
     )
+    ap.add_argument(
+        "--decode-impl", default="xla",
+        choices=["xla", "pallas", "fused"],
+        help="decode attention path; fused = flash-decode "
+             "(tools/fused_decode_onchip.py validates it first)",
+    )
     ap.add_argument("--probe-timeout", type=float, default=90.0)
     ap.add_argument(
         "--probe-budget", type=float, default=1500.0,
@@ -492,7 +506,8 @@ def main() -> int:
 
     if a.child:
         run_measurement(a.batch, a.cache_len, a.steps, a.config, a.kv_dtype,
-                        "int8" if a.quantize == "auto" else a.quantize)
+                        "int8" if a.quantize == "auto" else a.quantize,
+                        a.decode_impl)
         return 0
 
     # Validate --config up front (importing the module does not initialize
@@ -545,7 +560,7 @@ def main() -> int:
         fail_quant = quant  # label any failure with the tier that produced it
         i += 1
         argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype,
-                          quant)
+                          quant, a.decode_impl)
         try:
             proc = subprocess.run(
                 argv, capture_output=True, text=True, timeout=a.run_timeout,

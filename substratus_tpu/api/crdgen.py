@@ -46,12 +46,8 @@ def _schema(tp: Any) -> Dict[str, Any]:
 
 
 def crd_for(kind: str) -> Dict[str, Any]:
-    spec_cls = {
-        "Dataset": T.DatasetSpec,
-        "Model": T.ModelSpec,
-        "Notebook": T.NotebookSpec,
-        "Server": T.ServerSpec,
-    }[kind]
+    # the kind class carries its spec type — single source, no side map
+    spec_cls = type(T.KINDS[kind]().spec)
     plural = T.PLURALS[kind]
     status_schema = _schema(T.CommonStatus)
     return {

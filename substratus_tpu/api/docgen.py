@@ -1,0 +1,122 @@
+"""API reference generation from the dataclass types (reference: docs/api
+is the generated field reference for the CRDs; here the same artifact
+derives from the dataclasses that already generate the CRD schemas —
+one source of truth for apiserver validation, client serde, and docs).
+
+    python -m substratus_tpu.api.docgen > docs/api.md    (make api-docs)
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, get_args, get_origin
+
+from substratus_tpu.api import types as T
+from substratus_tpu.utils.serde import camel
+
+
+def _type_name(tp: Any) -> str:
+    origin = get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _type_name(args[0])
+    if origin in (list, typing.List):
+        (item,) = get_args(tp) or (str,)
+        return f"[]{_type_name(item)}"
+    if origin in (dict, typing.Dict):
+        kt, vt = get_args(tp) or (str, str)
+        return f"map[{_type_name(kt)}]{_type_name(vt)}"
+    if dataclasses.is_dataclass(tp):
+        return tp.__name__
+    return getattr(tp, "__name__", str(tp))
+
+
+def _doc_first_line(tp: Any) -> str:
+    doc = (tp.__doc__ or "").strip().splitlines()
+    if not doc or doc[0].startswith(f"{tp.__name__}("):
+        return ""  # dataclass auto-docstring, not documentation
+    return doc[0]
+
+
+def _walk(tp: Any, seen: dict) -> None:
+    """Collect every dataclass reachable from tp, in reference order."""
+    origin = get_origin(tp)
+    if origin is typing.Union:
+        for a in get_args(tp):
+            if a is not type(None):
+                _walk(a, seen)
+        return
+    if origin in (list, typing.List, dict, typing.Dict):
+        for a in get_args(tp):
+            _walk(a, seen)
+        return
+    if dataclasses.is_dataclass(tp) and tp.__name__ not in seen:
+        seen[tp.__name__] = tp
+        hints = typing.get_type_hints(tp)
+        for f in dataclasses.fields(tp):
+            _walk(hints[f.name], seen)
+
+
+def _render_table(tp: Any) -> str:
+    hints = typing.get_type_hints(tp)
+    rows = ["| Field | Type | Default |", "|---|---|---|"]
+    for f in dataclasses.fields(tp):
+        if f.default is not dataclasses.MISSING:
+            default = repr(f.default)
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+            default = repr(f.default_factory())
+        else:
+            default = ""
+        rows.append(
+            f"| `{camel(f.name)}` | `{_type_name(hints[f.name])}` |"
+            f" `{default}` |"
+        )
+    return "\n".join(rows)
+
+
+def render() -> str:
+    out = [
+        "# API reference",
+        "",
+        "Generated from the dataclass API types (`make api-docs` — do not",
+        "edit by hand). The same types generate the CRD schemas",
+        "(`make manifests`), so this document, the apiserver's validation,",
+        "and the client serde cannot drift apart.",
+        "",
+        f"All kinds are `apiVersion: {T.API_VERSION}`, namespaced, with a",
+        "status subresource and standard `metadata`.",
+        "",
+    ]
+    for kind in T.KINDS:
+        # the kind class IS the source of truth for its spec type — a
+        # fifth kind added to T.KINDS shows up here with no second map
+        spec = type(T.KINDS[kind]().spec)
+        out += [f"## {kind}", ""]
+        doc = _doc_first_line(spec)
+        if doc:
+            out += [doc, ""]
+        out += [f"### {kind} spec", "", _render_table(spec), ""]
+        nested: dict = {}
+        hints = typing.get_type_hints(spec)
+        for f in dataclasses.fields(spec):
+            _walk(hints[f.name], nested)
+        for name, tp in nested.items():
+            out += [f"#### {name}", ""]
+            d = _doc_first_line(tp)
+            if d:
+                out += [d, ""]
+            out += [_render_table(tp), ""]
+    out += ["## Common status", ""]
+    status_types: dict = {}
+    _walk(T.CommonStatus, status_types)
+    for name, tp in status_types.items():
+        out += [f"### {name}", ""]
+        d = _doc_first_line(tp)
+        if d:
+            out += [d, ""]
+        out += [_render_table(tp), ""]
+    return "\n".join(out) + "\n"
+
+
+if __name__ == "__main__":
+    print(render(), end="")

@@ -33,6 +33,7 @@ real v5e).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -41,6 +42,38 @@ from jax.experimental import pallas as pl
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
+
+# Independent grid tune for the backward dK/dV kernel (ROUND_NOTES r2:
+# dkv ran 0.92x vs XLA at 8k/16h while dq won — the dkv kernel loops over
+# q blocks per kv block, so its sweet spot differs from dq's). None =
+# inherit (block_q, block_k); set via set_dkv_blocks() or the env var
+# SUBSTRATUS_FLASH_DKV_BLOCKS="bq,bk"; swept by tools/flash_dkv_tune.py.
+_DKV_BLOCKS = None
+if os.environ.get("SUBSTRATUS_FLASH_DKV_BLOCKS"):
+    _parts = os.environ["SUBSTRATUS_FLASH_DKV_BLOCKS"].split(",")
+    if len(_parts) != 2:
+        raise ValueError(
+            "SUBSTRATUS_FLASH_DKV_BLOCKS must be 'block_q,block_k', got "
+            f"{os.environ['SUBSTRATUS_FLASH_DKV_BLOCKS']!r}"
+        )
+    _DKV_BLOCKS = (int(_parts[0]), int(_parts[1]))
+
+
+def set_dkv_blocks(blocks) -> None:
+    """Override the backward dK/dV kernel's (block_q, block_k); None
+    reverts to inheriting the forward/dq blocks."""
+    global _DKV_BLOCKS
+    assert blocks is None or len(blocks) == 2, blocks
+    _DKV_BLOCKS = tuple(blocks) if blocks else None
+
+
+def _fit_block(block: int, size: int) -> int:
+    """Clamp a requested block to the dimension: no larger than size,
+    halved until it divides (one invariant for dq AND dkv grids)."""
+    block = min(block, size)
+    while size % block:
+        block //= 2
+    return block
 NEG_INF = -1e30
 
 
@@ -311,12 +344,8 @@ def _flash_backward(
     b, sq, h, d = q.shape
     sk, kh = k.shape[1], k.shape[2]
     group = h // kh
-    block_q = min(block_q, sq)
-    while sq % block_q:
-        block_q //= 2
-    block_k = min(block_k, sk)
-    while sk % block_k:
-        block_k //= 2
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     nq, nk = sq // block_q, sk // block_k
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -368,6 +397,12 @@ def _flash_backward(
 
     # dK/dV per QUERY head (grid bh), then reduced over the GQA group —
     # parallel programs must not accumulate into a shared kv block.
+    # Block sizes tune independently of dq's (see _DKV_BLOCKS).
+    dkv_bq, dkv_bk = _DKV_BLOCKS or (block_q, block_k)
+    dkv_bq = _fit_block(dkv_bq, sq)
+    dkv_bk = _fit_block(dkv_bk, sk)
+    dkv_nq, dkv_nk = sq // dkv_bq, sk // dkv_bk
+
     def dkv_q_index(bh, ik, iq):
         return (bh, iq, 0)
 
@@ -384,30 +419,30 @@ def _flash_backward(
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        block_q=dkv_bq, block_k=dkv_bk, num_q_blocks=dkv_nq,
     )
     dkt, dvt = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, nk, nq),
+        grid=(b * h, dkv_nk, dkv_nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), dkv_q_index),
-            pl.BlockSpec((1, block_k, d), dkv_kv_index),
-            pl.BlockSpec((1, block_k, d), dkv_kv_index),
-            pl.BlockSpec((1, block_q, d), dkv_q_index),
-            pl.BlockSpec((1, block_q, 8), dkv_lse_index),
-            pl.BlockSpec((1, block_q, 8), dkv_lse_index),
+            pl.BlockSpec((1, dkv_bq, d), dkv_q_index),
+            pl.BlockSpec((1, dkv_bk, d), dkv_kv_index),
+            pl.BlockSpec((1, dkv_bk, d), dkv_kv_index),
+            pl.BlockSpec((1, dkv_bq, d), dkv_q_index),
+            pl.BlockSpec((1, dkv_bq, 8), dkv_lse_index),
+            pl.BlockSpec((1, dkv_bq, 8), dkv_lse_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), dkv_out_index),
-            pl.BlockSpec((1, block_k, d), dkv_out_index),
+            pl.BlockSpec((1, dkv_bk, d), dkv_out_index),
+            pl.BlockSpec((1, dkv_bk, d), dkv_out_index),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
         ],
         scratch_shapes=[
-            _vmem((block_k, d), jnp.float32),
-            _vmem((block_k, d), jnp.float32),
+            _vmem((dkv_bk, d), jnp.float32),
+            _vmem((dkv_bk, d), jnp.float32),
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,

@@ -61,6 +61,27 @@ def test_flash_backward_matches_reference(kh, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_flash_backward_dkv_block_override_parity():
+    """Retuning the dkv grid independently (set_dkv_blocks /
+    SUBSTRATUS_FLASH_DKV_BLOCKS, swept by tools/flash_dkv_tune.py) must
+    not change gradients — only the schedule."""
+    from substratus_tpu.ops.flash_attention import set_dkv_blocks
+
+    q, k, v = _qkv(s=128, kh=2)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, True, None, 64, 64, True) ** 2).sum()
+
+    base = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    try:
+        set_dkv_blocks((32, 128))  # different q AND k blocking than dq's
+        tuned = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        set_dkv_blocks(None)
+    for a, b in zip(tuned, base):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 @pytest.mark.parametrize("n", [2, 4])
 def test_ulysses_attention_matches_reference(mesh8, n):
     from substratus_tpu.ops.ulysses_attention import ulysses_attention

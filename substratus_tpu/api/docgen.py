@@ -35,7 +35,14 @@ def _doc_first_line(tp: Any) -> str:
     doc = (tp.__doc__ or "").strip().splitlines()
     if not doc or doc[0].startswith(f"{tp.__name__}("):
         return ""  # dataclass auto-docstring, not documentation
-    return doc[0]
+    # first PARAGRAPH (up to the blank line) — wrapped sentences must not
+    # ship truncated mid-clause
+    para = []
+    for line in doc:
+        if not line.strip():
+            break
+        para.append(line.strip())
+    return " ".join(para)
 
 
 def _walk(tp: Any, seen: dict) -> None:
@@ -87,6 +94,7 @@ def render() -> str:
         "status subresource and standard `metadata`.",
         "",
     ]
+    shared: dict = {}
     for kind in T.KINDS:
         # the kind class IS the source of truth for its spec type — a
         # fifth kind added to T.KINDS shows up here with no second map
@@ -96,16 +104,20 @@ def render() -> str:
         if doc:
             out += [doc, ""]
         out += [f"### {kind} spec", "", _render_table(spec), ""]
-        nested: dict = {}
+        # nested types collect ONCE into a shared section — rendering
+        # Build/Resources per kind would quadruple the doc and collide
+        # the markdown anchors
         hints = typing.get_type_hints(spec)
         for f in dataclasses.fields(spec):
-            _walk(hints[f.name], nested)
-        for name, tp in nested.items():
-            out += [f"#### {name}", ""]
-            d = _doc_first_line(tp)
-            if d:
-                out += [d, ""]
-            out += [_render_table(tp), ""]
+            _walk(hints[f.name], shared)
+    out += ["## Common types", "",
+            "Referenced from the spec tables above.", ""]
+    for name, tp in shared.items():
+        out += [f"### {name}", ""]
+        d = _doc_first_line(tp)
+        if d:
+            out += [d, ""]
+        out += [_render_table(tp), ""]
     out += ["## Common status", ""]
     status_types: dict = {}
     _walk(T.CommonStatus, status_types)

@@ -224,6 +224,18 @@ def main(argv=None) -> int:
             from substratus_tpu.ops.quant4 import set_q4_impl
 
             set_q4_impl("xla")
+        impl = getattr(cfg, "decode_attn_impl", "xla")
+        if impl != "xla":
+            # Same GSPMD limitation for the Pallas decode kernels (fused
+            # or unfused): no SPMD partitioning rule, so sharded serving
+            # falls back to the xla path (loudly, matching the
+            # resolve_kv_layout policy).
+            print(
+                f"decode_attn_impl={impl} is single-chip; sharded serving "
+                "falls back to xla decode",
+                flush=True,
+            )
+            cfg = cfg.replace(decode_attn_impl="xla")
     # Speculative decoding: a small draft model (same family) proposes,
     # the target verifies — engine-integrated, batched (serve/engine.py).
     draft = None

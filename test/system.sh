@@ -39,3 +39,12 @@ assert body["object"] == "text_completion", body
 assert body["usage"]["completion_tokens"] >= 1, body
 print("system test OK")
 '
+
+echo "=== chat surface (SSE streaming via sub chat)"
+CHAT=$(printf 'hi there\n/quit\n' | python -m substratus_tpu.cli.main chat \
+  --url "http://localhost:$PORT" --max-tokens 4 --temperature 0 --plain)
+# "model> " prints BEFORE the request, so assert on what comes after it:
+# streamed reply characters and no failure notice.
+echo "$CHAT" | grep -q "model> ." || { echo "chat streamed nothing"; exit 1; }
+echo "$CHAT" | grep -q "request failed" && { echo "chat request failed"; exit 1; }
+echo "chat smoke OK"

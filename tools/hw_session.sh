@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# The one-command hardware session: everything that needs a real chip,
+# in dependency order, each step logged to tools/hw_out/. Run it the
+# moment the device tunnel recovers (watcher: see docs/troubleshooting.md
+# "A TPU device hangs instead of failing").
+#
+#   bash tools/hw_session.sh            # full ladder (~20-30 min)
+#   bash tools/hw_session.sh quick      # parity probes only
+#
+# Order matters:
+#   1. q4_onchip        — int4 kernel compiles + parity + vs-int8 bench
+#                         (round-4 VERDICT gate #1)
+#   2. fused_decode_onchip — flash-decode Mosaic parity + chain bench
+#   3. flash_dkv_tune   — dkv grid sweep at the 8k/16h loser shape
+#   4. bench.py ladder  — the official capture, int4 first (auto), then
+#                         explicit variants for the record
+# Every step is independent: a failure logs and the session continues.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=tools/hw_out
+mkdir -p "$OUT"
+ts() { date -u +%H:%M:%S; }
+run() {
+  local name=$1; shift
+  echo "=== [$(ts)] $name: $*" | tee -a "$OUT/session.log"
+  # Must exceed bench.py's internal budgets (--probe-budget/--run-timeout
+  # default 1500s each) or the outer timeout kills a capture the inner
+  # watchdog would have landed.
+  if timeout "${STEP_TIMEOUT:-3600}" "$@" > "$OUT/$name.log" 2>&1; then
+    echo "=== [$(ts)] $name OK" | tee -a "$OUT/session.log"
+  else
+    echo "=== [$(ts)] $name FAILED (rc=$?) — see $OUT/$name.log" \
+      | tee -a "$OUT/session.log"
+  fi
+  tail -5 "$OUT/$name.log"
+}
+
+run q4_onchip          python tools/q4_onchip.py
+run fused_decode       python tools/fused_decode_onchip.py
+
+if [ "${1:-}" != "quick" ]; then
+  run dkv_tune         python tools/flash_dkv_tune.py
+  # Official-shape captures. auto tries int4 first with int8 fallback —
+  # the same invocation the driver makes — then the explicit variants
+  # that make the comparison table in docs/performance.md.
+  run bench_auto       python bench.py
+  run bench_int8       python bench.py --quantize int8 --no-fallback
+  run bench_int4       python bench.py --quantize int4 --no-fallback
+  run bench_int4_fused python bench.py --quantize int4 --decode-impl fused --no-fallback
+  run bench_int8_fused python bench.py --quantize int8 --decode-impl fused --no-fallback
+fi
+
+echo
+echo "captured JSON lines:"
+grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null || true
+echo "next: copy the numbers into ROUND_NOTES.md + docs/performance.md"

@@ -1,0 +1,231 @@
+"""RealKube REST contract against a stub apiserver.
+
+The conformance suite pins FakeKube to real-apiserver *semantics*; this
+suite pins RealKube to the real-apiserver *wire contract*: exact URL
+shapes per API group (core vs apps vs batch vs substratus.ai vs
+jobset.x-k8s.io), methods, the /status subresource path, list-item
+kind back-fill, watch streaming + resourceVersion resume, and HTTP
+error-code mapping. A typo'd group/plural here would 404 on a real
+cluster while passing every FakeKube test — exactly the divergence
+class VERDICT r3 called out.
+"""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from substratus_tpu.kube.client import Conflict, KubeError, NotFound
+from substratus_tpu.kube.real import RealKube
+
+
+class StubApiserver(http.server.BaseHTTPRequestHandler):
+    """Minimal apiserver: an in-memory store keyed by EXACT request path
+    (so a wrong URL is a 404, like the real thing), plus a scripted
+    configmaps watch stream."""
+
+    store = {}
+    requests_log = []
+    watch_connects = []
+
+    def _send(self, code, body=None):
+        data = json.dumps(body).encode() if body is not None else b""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        path, _, query = self.path.partition("?")
+        type(self).requests_log.append(("GET", self.path))
+        if "watch=true" in query:
+            if path.endswith("/configmaps"):
+                type(self).watch_connects.append(query)
+                if len(type(self).watch_connects) == 1:
+                    events = [
+                        {"type": "ADDED", "object": {
+                            "metadata": {"name": "w1",
+                                         "resourceVersion": "101"}}},
+                        {"type": "MODIFIED", "object": {
+                            "metadata": {"name": "w1",
+                                         "resourceVersion": "102"}}},
+                    ]
+                    payload = b"".join(
+                        json.dumps(e).encode() + b"\n" for e in events
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+            self._send(200)  # other kinds: empty watch, client retries
+            return
+        if path in type(self).store:
+            self._send(200, type(self).store[path])
+            return
+        # collection GET -> list of items under that collection path
+        items = [
+            v for k, v in type(self).store.items()
+            if k.startswith(path + "/")
+        ]
+        if items or any(k.startswith(path) for k in type(self).store):
+            stripped = []
+            for it in items:
+                it = dict(it)
+                it.pop("kind", None)  # real list items omit kind
+                stripped.append(it)
+            self._send(200, {"items": stripped})
+            return
+        self._send(404, {"message": "not found"})
+
+    def do_POST(self):
+        type(self).requests_log.append(("POST", self.path))
+        length = int(self.headers["Content-Length"])
+        obj = json.loads(self.rfile.read(length))
+        name = obj["metadata"]["name"]
+        key = f"{self.path}/{name}"
+        if key in type(self).store:
+            self._send(409, {"message": "exists"})
+            return
+        obj["metadata"]["resourceVersion"] = "1"
+        type(self).store[key] = obj
+        self._send(201, obj)
+
+    def do_PUT(self):
+        type(self).requests_log.append(("PUT", self.path))
+        length = int(self.headers["Content-Length"])
+        obj = json.loads(self.rfile.read(length))
+        path = self.path
+        if path.endswith("/status"):
+            base = path[: -len("/status")]
+            if base not in type(self).store:
+                self._send(404, {"message": "not found"})
+                return
+            type(self).store[base]["status"] = obj.get("status")
+            self._send(200, type(self).store[base])
+            return
+        if path == "/api/v1/namespaces/default/configmaps/boom":
+            self._send(500, {"message": "internal"})
+            return
+        if path not in type(self).store:
+            self._send(404, {"message": "not found"})
+            return
+        type(self).store[path] = obj
+        self._send(200, obj)
+
+    def do_DELETE(self):
+        type(self).requests_log.append(("DELETE", self.path))
+        if self.path not in type(self).store:
+            self._send(404, {"message": "not found"})
+            return
+        del type(self).store[self.path]
+        self._send(200, {})
+
+
+@pytest.fixture()
+def stub():
+    StubApiserver.store = {}
+    StubApiserver.requests_log = []
+    StubApiserver.watch_connects = []
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), StubApiserver)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = RealKube(f"http://127.0.0.1:{httpd.server_port}")
+    yield client, StubApiserver
+    client.stop()
+    httpd.shutdown()
+
+
+def _cm(name):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default"},
+            "data": {"k": "v"}}
+
+
+def test_rest_paths_per_api_group(stub):
+    """Every kind hits its exact group/version/plural URL — the wire
+    contract a real apiserver enforces with 404s."""
+    client, srv = stub
+    cases = [
+        (_cm("c1"), "/api/v1/namespaces/default/configmaps"),
+        ({"apiVersion": "apps/v1", "kind": "Deployment",
+          "metadata": {"name": "d1", "namespace": "default"}, "spec": {}},
+         "/apis/apps/v1/namespaces/default/deployments"),
+        ({"apiVersion": "batch/v1", "kind": "Job",
+          "metadata": {"name": "j1", "namespace": "default"}, "spec": {}},
+         "/apis/batch/v1/namespaces/default/jobs"),
+        ({"apiVersion": "substratus.ai/v1", "kind": "Model",
+          "metadata": {"name": "m1", "namespace": "default"}, "spec": {}},
+         "/apis/substratus.ai/v1/namespaces/default/models"),
+        ({"apiVersion": "jobset.x-k8s.io/v1alpha2", "kind": "JobSet",
+          "metadata": {"name": "js1", "namespace": "default"}, "spec": {}},
+         "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"),
+        ({"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+          "metadata": {"name": "l1", "namespace": "default"}, "spec": {}},
+         "/apis/coordination.k8s.io/v1/namespaces/default/leases"),
+    ]
+    for obj, want_path in cases:
+        client.create(obj)
+        assert ("POST", want_path) in srv.requests_log, (
+            obj["kind"], srv.requests_log[-1],
+        )
+
+
+def test_crud_round_trip_and_status_subresource(stub):
+    client, srv = stub
+    client.create(_cm("c1"))
+    got = client.get("ConfigMap", "default", "c1")
+    assert got["data"] == {"k": "v"}
+
+    got["data"]["k"] = "v2"
+    client.update(got)
+    assert client.get("ConfigMap", "default", "c1")["data"]["k"] == "v2"
+
+    got["status"] = {"observed": True}
+    client.update_status(got)
+    assert ("PUT", "/api/v1/namespaces/default/configmaps/c1/status") in \
+        srv.requests_log
+
+    # list backfills the kind that real list items omit
+    items = client.list("ConfigMap", "default")
+    assert items and items[0]["kind"] == "ConfigMap"
+
+    client.delete("ConfigMap", "default", "c1")
+    with pytest.raises(NotFound):
+        client.get("ConfigMap", "default", "c1")
+
+
+def test_http_error_mapping(stub):
+    client, _ = stub
+    with pytest.raises(NotFound):
+        client.get("ConfigMap", "default", "ghost")
+    client.create(_cm("dup"))
+    with pytest.raises(Conflict):
+        client.create(_cm("dup"))
+    client.create(_cm("boom"))
+    with pytest.raises(KubeError):
+        client.update(_cm("boom"))  # stub returns 500 for this name
+
+
+def test_watch_streams_and_resumes_with_resource_version(stub):
+    client, srv = stub
+    events = []
+    client.add_listener(lambda t, o: events.append((t, o)))
+    deadline = time.monotonic() + 15
+    while len(events) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    types = [t for t, _ in events]
+    assert "ADDED" in types and "MODIFIED" in types
+    cm_events = [o for _, o in events
+                 if o["metadata"]["name"] == "w1"]
+    assert cm_events[0]["kind"] == "ConfigMap"  # kind backfilled
+    # the reconnect after the stream closed must resume from the last
+    # seen resourceVersion
+    while len(srv.watch_connects) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert any("resourceVersion=102" in q
+               for q in srv.watch_connects[1:]), srv.watch_connects

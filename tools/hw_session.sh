@@ -21,17 +21,21 @@ cd "$(dirname "$0")/.."
 OUT=tools/hw_out
 mkdir -p "$OUT"
 ts() { date -u +%H:%M:%S; }
+FAILURES=0
 run() {
   local name=$1; shift
   echo "=== [$(ts)] $name: $*" | tee -a "$OUT/session.log"
-  # Must exceed bench.py's internal budgets (--probe-budget/--run-timeout
-  # default 1500s each) or the outer timeout kills a capture the inner
-  # watchdog would have landed.
-  if timeout "${STEP_TIMEOUT:-3600}" "$@" > "$OUT/$name.log" 2>&1; then
+  # Must exceed bench.py's LADDER worst case, not just one watchdog:
+  # probe-budget (1500s) + per-tier run-timeout (1500s) across the
+  # fallback tiers + the hang-retry re-probe — a wedged-then-recovering
+  # tunnel can legitimately spend hours inside one bench invocation.
+  if timeout "${STEP_TIMEOUT:-14400}" "$@" > "$OUT/$name.log" 2>&1; then
     echo "=== [$(ts)] $name OK" | tee -a "$OUT/session.log"
   else
-    echo "=== [$(ts)] $name FAILED (rc=$?) — see $OUT/$name.log" \
+    local rc=$?  # before $(ts) clobbers it
+    echo "=== [$(ts)] $name FAILED (rc=$rc) — see $OUT/$name.log" \
       | tee -a "$OUT/session.log"
+    FAILURES=$((FAILURES + 1))
   fi
   tail -5 "$OUT/$name.log"
 }
@@ -55,3 +59,5 @@ echo
 echo "captured JSON lines:"
 grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null || true
 echo "next: copy the numbers into ROUND_NOTES.md + docs/performance.md"
+# Nonzero when any step failed so a watcher/CI wrapper can keep retrying.
+exit "$FAILURES"

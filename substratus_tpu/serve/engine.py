@@ -57,13 +57,17 @@ class EngineConfig:
     # if the pool runs dry mid-decode.
     kv_pool_tokens: Optional[int] = None
     prefix_cache: bool = True  # share full prompt-prefix pages across requests
-    # Speculative decoding (paged layout only): the draft model proposes
-    # spec_k greedy tokens per iteration and ONE target forward verifies all
-    # of them — decode is HBM-bound, so accepted tokens amortize the weight
-    # stream. Greedy slots stay token-exact (longest matching prefix +
-    # correction); sampling slots take the verify pass's position-0 sample
-    # (one token, plain-decode semantics). 0 = off; requires draft= at
-    # Engine construction.
+    # Speculative decoding (paged layout only): a proposer guesses spec_k
+    # greedy tokens per iteration and ONE target forward verifies all of
+    # them — decode is HBM-bound, so accepted tokens amortize the weight
+    # stream. With draft=(cfg, params) at Engine construction the proposer
+    # is the draft model; WITHOUT one it is prompt-lookup decoding (the
+    # continuation after the most recent match of the context's trailing
+    # n-gram — zero extra model cost, wins on repetitive outputs:
+    # summarization, RAG, code edits). Greedy slots stay token-exact
+    # (longest matching prefix + correction); sampling slots take the
+    # verify pass's position-0 sample (one token, plain-decode semantics).
+    # 0 = off.
     spec_k: int = 0
 
 
@@ -265,12 +269,12 @@ class Engine:
         # entries too).
         if ec.spec_k < 0:
             raise ValueError(f"spec_k {ec.spec_k} invalid")
-        if ec.spec_k and draft is None:
-            raise ValueError("spec_k requires a draft=(cfg, params) model")
         self.spec = bool(ec.spec_k)
+        # draft model proposer, or prompt-lookup when no draft is given
+        self.spec_draft = self.spec and draft is not None
         if self.spec and not self.paged:
             raise ValueError("spec_k requires the paged kv layout")
-        if self.spec:
+        if self.spec_draft:
             self.draft_cfg, draft_params = draft
             self.draft_params = draft_params
             if mesh is not None:
@@ -307,13 +311,14 @@ class Engine:
 
         self._decode_fn = self._build_decode()
         self._chunk_fn = partial(self._chunk_prefill_jit, self.model, self.cfg)
-        if self.spec:
+        if self.spec_draft:
             self._draft_chunk_fn = partial(
                 self._chunk_prefill_jit, self.model, self.draft_cfg
             )
             self._propose_fn = partial(
                 self._propose_jit, self.model, self.draft_cfg, self.ec.spec_k
             )
+        if self.spec:
             self._verify_fn = self._build_verify()
         if not self.paged:
             self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
@@ -616,7 +621,7 @@ class Engine:
         self.stats["prefill_tokens"] += true_len - reuse
         self.stats["prefix_hit_tokens"] += reuse
 
-        if self.spec:
+        if self.spec_draft:
             # Draft prefill also starts at `reuse`: the draft pool indexes
             # through the same block table, and shared pages already hold
             # valid draft KV — registered pages are only ever written during
@@ -774,12 +779,56 @@ class Engine:
         for slot in np.flatnonzero(self.active):
             self._emit(int(slot), int(host_tokens[slot]))
 
+    @staticmethod
+    def _prompt_lookup(ctx, k: int, max_n: int = 3):
+        """Prompt-lookup proposal: the continuation after the most recent
+        earlier occurrence of the context's trailing n-gram (largest n
+        first). Returns k tokens, or None when nothing matches — pure
+        host work, no model involved; the scan is vectorized numpy so a
+        max-context slot costs microseconds, not interpreter loops."""
+        a = np.asarray(ctx, np.int32)
+        L = a.size
+        for n in range(min(max_n, L - 1), 0, -1):
+            tgt = a[L - n:]
+            # candidate starts j in [0, L-n-1]: the trailing n-gram itself
+            # (j = L-n) is excluded by windowing over a[:L-1]
+            win = np.lib.stride_tricks.sliding_window_view(a[: L - 1], n)
+            hits = np.flatnonzero((win == tgt).all(axis=1))
+            if hits.size:
+                j = int(hits[-1])  # most recent occurrence
+                cont = a[j + n: j + n + k]
+                if cont.size:
+                    out = np.full((k,), cont[-1], np.int32)
+                    out[: cont.size] = cont
+                    return out
+        return None
+
+    def _lookup_propose(self, k: int):
+        """Draft-free proposals for every active slot from its own token
+        history. Returns (proposals [max_batch, k] int32, matched mask
+        [max_batch] — placeholder rows must not count as proposals)."""
+        props = np.zeros((self.ec.max_batch, k), np.int32)
+        matched = np.zeros((self.ec.max_batch,), bool)
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            req = self.slot_req[slot]
+            keep = self.ec.max_seq_len - 1
+            ctx = (req.prompt_tokens[-keep:] or [0]) + self.slot_tokens[slot]
+            guess = self._prompt_lookup(ctx, k)
+            if guess is None:
+                props[slot] = ctx[-1]  # placeholder; verify still emits 1
+            else:
+                props[slot] = guess
+                matched[slot] = True
+        return props, matched
+
     def _spec_step(self) -> None:
-        """One speculative iteration for the whole batch: draft proposes
-        spec_k tokens, one target forward verifies k+1 positions. Greedy
-        slots emit the longest matching prefix (+ the target's correction
-        on a mismatch) — token-exact vs plain decode; sampling slots emit
-        the verify pass's position-0 sample. Cache staleness beyond the
+        """One speculative iteration for the whole batch: the proposer
+        (draft model, or prompt-lookup when draft-free) guesses spec_k
+        tokens, one target forward verifies k+1 positions. Greedy slots
+        emit the longest matching prefix (+ the target's correction on a
+        mismatch) — token-exact vs plain decode; sampling slots emit the
+        verify pass's position-0 sample. Cache staleness beyond the
         accepted point is safe: causal masking never reads past the query
         position, and the next round rewrites exactly those slots."""
         k = self.ec.spec_k
@@ -792,16 +841,29 @@ class Engine:
         ):
             self._decode_step()
             return
+        lookup_props = None
+        lookup_matched = None
+        if not self.spec_draft:
+            # Propose BEFORE paying for capacity/verify: a round with no
+            # n-gram match anywhere degrades to one plain decode step
+            # instead of a (k+1)-wide verify that accepts nothing.
+            lookup_props, lookup_matched = self._lookup_propose(k)
+            if not lookup_matched.any():
+                self._decode_step()
+                return
         for slot in np.flatnonzero(self.active):
             self._ensure_capacity(
                 int(slot), int(self.host_positions[slot]) + k
             )
         if not self.active.any():
             return
-        proposals, self.draft_cache = self._propose_fn(
-            self.draft_params, self.draft_cache, self.block_table,
-            self.tokens, self.positions,
-        )
+        if self.spec_draft:
+            proposals, self.draft_cache = self._propose_fn(
+                self.draft_params, self.draft_cache, self.block_table,
+                self.tokens, self.positions,
+            )
+        else:
+            proposals = jnp.asarray(lookup_props)
         block = jnp.concatenate([self.tokens[:, None], proposals], axis=1)
         choices, sampled, self.cache, self.key = self._verify_fn(
             self.params, self.cache, self.block_table, block,
@@ -825,8 +887,12 @@ class Engine:
                     and props[slot, accepted] == chs[slot, accepted]
                 ):
                     accepted += 1
-                self.stats["spec_proposed"] += k
-                self.stats["spec_accepted"] += accepted
+                if lookup_matched is None or lookup_matched[slot]:
+                    # placeholder rows (no n-gram match) are not real
+                    # proposals — counting them would skew the
+                    # acceptance-rate statistic
+                    self.stats["spec_proposed"] += k
+                    self.stats["spec_accepted"] += accepted
                 if accepted == k:
                     # Full acceptance: no bonus token — the draft never
                     # wrote the last proposal's kv, so it must seed the

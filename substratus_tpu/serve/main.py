@@ -245,6 +245,14 @@ def main(argv=None) -> int:
         if args.spec_k is not None
         else int(params_json.get("spec_k", 0))
     )
+    if spec_k and kv_layout == "dense":
+        # Speculation needs the paged pool; dense (e.g. forced by
+        # decode_attn_impl=fused) warns and serves unsped rather than
+        # crashing at Engine construction. Applies to draft AND
+        # prompt-lookup modes alike.
+        print("spec_k set but kv_layout=dense; speculation disabled",
+              flush=True)
+        spec_k = 0
     if draft_dir and spec_k:
         draft_cfg, draft_params = load_checkpoint(draft_dir)
         if registry.module_of(draft_cfg) is not family:
@@ -259,7 +267,11 @@ def main(argv=None) -> int:
         ec.spec_k = spec_k
         print(f"speculative decoding: draft={draft_dir} k={spec_k}", flush=True)
     elif spec_k:
-        print("spec_k set but no draft model; speculation disabled", flush=True)
+        # No draft model: prompt-lookup decoding — the engine proposes the
+        # continuation after the latest match of the context's trailing
+        # n-gram (host-side, zero model cost; serve/engine.py).
+        ec.spec_k = spec_k
+        print(f"speculative decoding: prompt-lookup k={spec_k}", flush=True)
 
     engine = Engine(cfg, params, ec, mesh=mesh, model=family, draft=draft)
     engine.start()

@@ -68,12 +68,14 @@ class StubApiserver(http.server.BaseHTTPRequestHandler):
         if path in type(self).store:
             self._send(200, type(self).store[path])
             return
-        # collection GET -> list of items under that collection path
+        # collection GET: the path must be the EXACT parent of stored
+        # keys — a prefix-typo ('.../configmap') must 404 exactly like a
+        # real apiserver, which is the contract this suite pins.
         items = [
             v for k, v in type(self).store.items()
-            if k.startswith(path + "/")
+            if k.rsplit("/", 1)[0] == path
         ]
-        if items or any(k.startswith(path) for k in type(self).store):
+        if items:
             stripped = []
             for it in items:
                 it = dict(it)

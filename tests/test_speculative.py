@@ -172,3 +172,96 @@ def test_engine_speculation_sampling_slots_complete():
         assert all(len(o) >= 1 for o in outs)
     finally:
         spec.stop()
+
+
+def test_prompt_lookup_proposer_unit():
+    """The n-gram matcher: longest trailing n-gram wins, most recent
+    match wins, continuations pad, and no-match returns None."""
+    from substratus_tpu.serve.engine import Engine
+
+    pld = Engine._prompt_lookup
+    # trailing [7, 8] matched earlier; continuation follows it
+    assert list(pld([7, 8, 9, 1, 7, 8], k=2)) == [9, 1]
+    # most RECENT match wins: two occurrences, later one continues with 5
+    assert list(pld([1, 2, 3, 1, 2, 5, 1, 2], k=1)) == [5]
+    # short continuation pads with its last token
+    assert list(pld([4, 6, 4, 6, 4, 6], k=4))[:2] == [4, 6]
+    # nothing repeats -> None
+    assert pld([1, 2, 3, 4, 5], k=3) is None
+
+
+def test_engine_prompt_lookup_exact_and_accelerated():
+    """Draft-free speculation (spec_k with no draft model) stays
+    token-exact vs plain decode, and on a model that falls into a
+    repetition loop the lookup proposals get accepted (> 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    # A repetitive prompt helps the tiny random model settle into loops.
+    prompts = [[256] + [11, 12, 13] * 6, [256, 9, 8, 7]]
+
+    plain = Engine(
+        cfg, params,
+        EngineConfig(max_batch=2, max_seq_len=128, eos_token_id=257),
+    )
+    plain.start()
+    try:
+        want = _drain(plain, prompts, temperature=0.0, max_tokens=32)
+    finally:
+        plain.stop()
+
+    pld = Engine(
+        cfg, params,
+        EngineConfig(max_batch=2, max_seq_len=128, eos_token_id=257,
+                     spec_k=3),
+        # no draft= -> prompt-lookup proposer
+    )
+    pld.start()
+    try:
+        got = _drain(pld, prompts, temperature=0.0, max_tokens=32)
+        assert got == want, (got, want)
+        # random tiny models degenerate into repetition, so lookup hits
+        assert pld.stats["spec_accepted"] > 0, pld.stats
+    finally:
+        pld.stop()
+
+
+def test_engine_prompt_lookup_no_match_falls_back():
+    """When no slot's context repeats, the scheduler degrades to plain
+    decode steps (no wasted k+1-wide verifies) and stays exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(1))
+    prompts = [[256, 40, 41, 42, 43, 44]]
+
+    plain = Engine(
+        cfg, params,
+        EngineConfig(max_batch=1, max_seq_len=64, eos_token_id=257),
+    )
+    plain.start()
+    try:
+        want = _drain(plain, prompts, temperature=0.0, max_tokens=6)
+    finally:
+        plain.stop()
+
+    pld = Engine(
+        cfg, params,
+        EngineConfig(max_batch=1, max_seq_len=64, eos_token_id=257,
+                     spec_k=3),
+    )
+    pld.start()
+    try:
+        got = _drain(pld, prompts, temperature=0.0, max_tokens=6)
+        assert got == want, (got, want)
+    finally:
+        pld.stop()

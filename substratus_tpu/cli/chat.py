@@ -175,8 +175,7 @@ def run_chat(args) -> int:
 
     client = commands._client(args)
     ns = getattr(args, "namespace", "default") or "default"
-    server = client.get("Server", ns, args.name)
-    del server
+    client.get("Server", ns, args.name)  # NotFound here beats a pod hunt
     pods = [
         p for p in client.list("Pod", ns)
         if p["metadata"].get("labels", {}).get("substratus.ai/object")

@@ -45,6 +45,33 @@ def test_tensor_parallel_engine_matches_single_device(setup):
     assert "tensor" in str(spec), spec
 
 
+def test_tensor_parallel_int4_engine_matches_single_device(setup):
+    """int4 weights through a (data x tensor) mesh — the 70B-serving
+    headline configuration — must be token-exact vs the single-device
+    int4 engine. Uses the SPMD-shardable XLA lowering, exactly as
+    serve/main pins it for sharded serving (ops/quant4.py)."""
+    from substratus_tpu.ops.quant4 import quantize4_params, set_q4_impl
+
+    cfg, params = setup
+    qparams = quantize4_params(params, llama.quant_contracting(cfg))
+    prompts = [[256, 5, 6, 7], [256, 70, 71]]
+    ec = lambda: EngineConfig(max_batch=4, max_seq_len=64, eos_token_id=257)
+
+    set_q4_impl("xla")
+    try:
+        single = _run(Engine(cfg, qparams, ec()), prompts)
+        mesh = build_mesh(data=2, tensor=2, fsdp=2)
+        sharded = _run(Engine(cfg, qparams, ec(), mesh=mesh), prompts)
+    finally:
+        set_q4_impl(None)
+    assert sharded == single, (sharded, single)
+
+    # Sanity: the packed int4 weights themselves are tensor-sharded.
+    eng = Engine(cfg, qparams, ec(), mesh=mesh)
+    spec = eng.params["layers"]["wq"].packed.sharding.spec
+    assert "tensor" in str(spec), spec
+
+
 def test_north_star_70b_structure_engine_matrix():
     """Execute the ACTUAL engine — paged KV, chunked prefill, prefix
     cache, speculative decoding — over a 16-device virtual mesh at

@@ -50,6 +50,7 @@ def test_tensor_parallel_int4_engine_matches_single_device(setup):
     headline configuration — must be token-exact vs the single-device
     int4 engine. Uses the SPMD-shardable XLA lowering, exactly as
     serve/main pins it for sharded serving (ops/quant4.py)."""
+    from substratus_tpu.ops import quant4
     from substratus_tpu.ops.quant4 import quantize4_params, set_q4_impl
 
     cfg, params = setup
@@ -57,13 +58,14 @@ def test_tensor_parallel_int4_engine_matches_single_device(setup):
     prompts = [[256, 5, 6, 7], [256, 70, 71]]
     ec = lambda: EngineConfig(max_batch=4, max_seq_len=64, eos_token_id=257)
 
+    prev_impl = quant4._FORCE_IMPL
     set_q4_impl("xla")
     try:
         single = _run(Engine(cfg, qparams, ec()), prompts)
         mesh = build_mesh(data=2, tensor=2, fsdp=2)
         sharded = _run(Engine(cfg, qparams, ec(), mesh=mesh), prompts)
     finally:
-        set_q4_impl(None)
+        set_q4_impl(prev_impl)
     assert sharded == single, (sharded, single)
 
     # Sanity: the packed int4 weights themselves are tensor-sharded.

@@ -1,0 +1,458 @@
+"""GGUF checkpoint import: bring a llama.cpp model file to TPU serving.
+
+The reference's quantized serving path consumes GGUF through llama.cpp
+(SURVEY.md §2.2 model-server-llama-cpp; reference
+examples/llama2-13b-chat-gguf/base-model.yaml imports a 4-bit GGUF).
+Here the same file loads straight into the TPU engine: the GGUF binary
+is parsed (v2/v3), GGML-quantized tensors dequantize block-wise in
+numpy, q/k projections un-permute from llama.cpp's rope layout back to
+the HF convention our models use, and the result feeds the SAME
+convert_llama_state_dict as an HF checkpoint. Serve with
+`--quantize int4` to re-quantize into the TPU-native nibble-packed
+layout (ops/quant4.py) — g128 grouping rather than GGML's 32-blocks,
+because that is what the Pallas unpack-dequant matmul wants.
+
+Format notes (GGUF spec, ggml/docs/gguf.md):
+  header: magic "GGUF", version u32, n_tensors u64, n_kv u64
+  kv: string key, u32 value-type, value (strings u64-length-prefixed;
+      arrays are [elem-type u32][count u64][elems])
+  tensor infos: name, n_dims u32, dims u64[n] (ne[0] = contiguous dim),
+      ggml type u32, offset u64 (relative to the aligned data section)
+  data: aligned to general.alignment (default 32)
+
+Supported tensor types: F32, F16, Q4_0, Q4_1, Q5_0, Q8_0 — the llama.cpp
+quantizations the reference's example images actually shipped.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Dict, List, Tuple
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+
+# ggml tensor types (type id -> (block elements, block bytes))
+GGML_F32 = 0
+GGML_F16 = 1
+GGML_Q4_0 = 2
+GGML_Q4_1 = 3
+GGML_Q5_0 = 6
+GGML_Q8_0 = 8
+_BLOCK = {
+    GGML_F32: (1, 4),
+    GGML_F16: (1, 2),
+    GGML_Q4_0: (32, 2 + 16),
+    GGML_Q4_1: (32, 2 + 2 + 16),
+    GGML_Q5_0: (32, 2 + 4 + 16),
+    GGML_Q8_0: (32, 2 + 32),
+}
+
+# gguf metadata value types
+_SCALAR_FMT = {
+    0: "B", 1: "b", 2: "<H", 3: "<h", 4: "<I", 5: "<i", 6: "<f",
+    7: "?", 10: "<Q", 11: "<q", 12: "<d",
+}
+_T_STRING = 8
+_T_ARRAY = 9
+
+
+def _read(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    return struct.unpack(fmt, f.read(size))[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read(f, "<Q")
+    return f.read(n).decode("utf-8", "replace")
+
+
+def _read_value(f: BinaryIO, vtype: int):
+    if vtype in _SCALAR_FMT:
+        return _read(f, _SCALAR_FMT[vtype])
+    if vtype == _T_STRING:
+        return _read_string(f)
+    if vtype == _T_ARRAY:
+        etype = _read(f, "<I")
+        count = _read(f, "<Q")
+        return [_read_value(f, etype) for _ in range(count)]
+    raise ValueError(f"gguf: unknown metadata value type {vtype}")
+
+
+def _dequantize(raw: bytes, ggml_type: int, n: int) -> np.ndarray:
+    """GGML block formats -> float32 [n]."""
+    if ggml_type not in _BLOCK:
+        raise ValueError(
+            f"gguf: unsupported tensor type {ggml_type} (supported: "
+            "F32/F16/Q4_0/Q4_1/Q5_0/Q8_0; K-quants like Q4_K are not — "
+            "re-export the model with a supported quantization)"
+        )
+    if ggml_type == GGML_F32:
+        return np.frombuffer(raw, "<f4", n).astype(np.float32)
+    if ggml_type == GGML_F16:
+        return np.frombuffer(raw, "<f2", n).astype(np.float32)
+    qk, bsz = _BLOCK[ggml_type]
+    nb = n // qk
+    blocks = np.frombuffer(raw, np.uint8, nb * bsz).reshape(nb, bsz)
+    if ggml_type == GGML_Q4_0:
+        d = blocks[:, :2].copy().view("<f2").astype(np.float32)  # [nb, 1]
+        qs = blocks[:, 2:]
+        lo = (qs & 0x0F).astype(np.int8) - 8
+        hi = (qs >> 4).astype(np.int8) - 8
+        q = np.concatenate([lo, hi], axis=1)  # [nb, 32]: j, j+16 halves
+        return (q * d).astype(np.float32).reshape(-1)
+    if ggml_type == GGML_Q4_1:
+        d = blocks[:, :2].copy().view("<f2").astype(np.float32)
+        m = blocks[:, 2:4].copy().view("<f2").astype(np.float32)
+        qs = blocks[:, 4:]
+        lo = (qs & 0x0F).astype(np.float32)
+        hi = (qs >> 4).astype(np.float32)
+        q = np.concatenate([lo, hi], axis=1)
+        return (q * d + m).astype(np.float32).reshape(-1)
+    if ggml_type == GGML_Q5_0:
+        d = blocks[:, :2].copy().view("<f2").astype(np.float32)
+        qh = blocks[:, 2:6].copy().view("<u4")  # [nb, 1] fifth-bit mask
+        qs = blocks[:, 6:]
+        lo4 = (qs & 0x0F).astype(np.int32)
+        hi4 = (qs >> 4).astype(np.int32)
+        shifts = np.arange(32, dtype=np.uint32)
+        bit = ((qh >> shifts) & 1).astype(np.int32)  # [nb, 32]
+        lo = lo4 | (bit[:, :16] << 4)
+        hi = hi4 | (bit[:, 16:] << 4)
+        q = np.concatenate([lo, hi], axis=1) - 16
+        return (q * d).astype(np.float32).reshape(-1)
+    if ggml_type == GGML_Q8_0:
+        d = blocks[:, :2].copy().view("<f2").astype(np.float32)
+        q = blocks[:, 2:].copy().view(np.int8).astype(np.float32)
+        return (q * d).astype(np.float32).reshape(-1)
+    raise ValueError(f"gguf: unsupported tensor type {ggml_type}")
+
+
+# (path, mtime) -> metadata dict. The serve startup parses the same file
+# for weights and again for the tokenizer; vocab arrays are the bulk of
+# the kv section and decode via per-element struct calls, so parse once.
+_META_CACHE: Dict[Tuple[str, float], Dict[str, Any]] = {}
+
+
+def read_gguf(
+    path: str, with_tensors: bool = True
+) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Parse a .gguf file -> (metadata dict, {tensor name: ndarray}).
+
+    Tensor arrays come back in the llama.cpp/torch orientation
+    ([out_features, in_features] for matmuls): GGUF dims are ne[0]=
+    contiguous first, so the numpy shape is the reverse. F32 tensors stay
+    f32 (exactness); everything else dequantizes to f16 — a 70B Q4 file
+    would otherwise peak at ~8x its size in host RAM (the quantized
+    source never had more than f16 precision anyway).
+
+    with_tensors=False parses only the header/metadata (cheap: the
+    tokenizer lives there; and cached per (path, mtime))."""
+    import os as _os
+
+    cache_key = (path, _os.path.getmtime(path))
+    cached = _META_CACHE.get(cache_key)
+    if cached is not None and not with_tensors:
+        return cached, {}
+    with open(path, "rb") as f:
+        if f.read(4) != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        version = _read(f, "<I")
+        if version not in (2, 3):
+            raise ValueError(f"{path}: unsupported GGUF version {version}")
+        n_tensors = _read(f, "<Q")
+        n_kv = _read(f, "<Q")
+        meta: Dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = _read_string(f)
+            vtype = _read(f, "<I")
+            meta[key] = _read_value(f, vtype)
+        _META_CACHE.clear()  # one model per process; don't hoard vocabs
+        _META_CACHE[cache_key] = meta
+        if not with_tensors:
+            return meta, {}
+        infos: List[Tuple[str, Tuple[int, ...], int, int]] = []
+        for _ in range(n_tensors):
+            name = _read_string(f)
+            n_dims = _read(f, "<I")
+            ne = [_read(f, "<Q") for _ in range(n_dims)]
+            ggml_type = _read(f, "<I")
+            offset = _read(f, "<Q")
+            infos.append((name, tuple(ne), ggml_type, offset))
+        align = int(meta.get("general.alignment", 32))
+        pos = f.tell()
+        data_start = (pos + align - 1) // align * align
+        tensors: Dict[str, np.ndarray] = {}
+        for name, ne, ggml_type, offset in infos:
+            if ggml_type not in _BLOCK:
+                raise ValueError(
+                    f"gguf: tensor {name!r} has unsupported type "
+                    f"{ggml_type} (supported: F32/F16/Q4_0/Q4_1/Q5_0/"
+                    "Q8_0; K-quants like Q4_K are not — re-export with a "
+                    "supported quantization)"
+                )
+            n = 1
+            for d in ne:
+                n *= d
+            qk, bsz = _BLOCK[ggml_type]
+            nbytes = n // qk * bsz
+            f.seek(data_start + offset)
+            flat = _dequantize(f.read(nbytes), ggml_type, n)
+            if ggml_type != GGML_F32:
+                flat = flat.astype(np.float16)  # bound host-RAM peak
+            # ne[0] is contiguous -> numpy shape is reversed(ne)
+            tensors[name] = flat.reshape(tuple(reversed(ne)))
+    return meta, tensors
+
+
+def _unpermute_qk(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Invert llama.cpp's rope permutation on a q/k projection.
+
+    llama.cpp's HF->GGUF conversion reorders each head's rows from HF's
+    rotate-half layout [r0..r{h/2-1}, i0..i{h/2-1}] to interleaved pairs;
+    our models (and convert_llama_state_dict) expect the HF layout, so
+    invert it: rows were written as reshape(n_head, 2, h/2)->swap(1,2)."""
+    out, dim = w.shape
+    hd = out // n_head
+    return (
+        w.reshape(n_head, hd // 2, 2, dim)
+        .swapaxes(1, 2)
+        .reshape(out, dim)
+    )
+
+
+# gguf tensor name -> HF state-dict name ({i} = layer index)
+_NAME_MAP = {
+    "token_embd.weight": "embed_tokens.weight",
+    "output_norm.weight": "norm.weight",
+    "output.weight": "lm_head.weight",
+    "blk.{i}.attn_norm.weight": "layers.{i}.input_layernorm.weight",
+    "blk.{i}.attn_q.weight": "layers.{i}.self_attn.q_proj.weight",
+    "blk.{i}.attn_k.weight": "layers.{i}.self_attn.k_proj.weight",
+    "blk.{i}.attn_v.weight": "layers.{i}.self_attn.v_proj.weight",
+    "blk.{i}.attn_output.weight": "layers.{i}.self_attn.o_proj.weight",
+    "blk.{i}.ffn_norm.weight": "layers.{i}.post_attention_layernorm.weight",
+    "blk.{i}.ffn_gate.weight": "layers.{i}.mlp.gate_proj.weight",
+    "blk.{i}.ffn_up.weight": "layers.{i}.mlp.up_proj.weight",
+    "blk.{i}.ffn_down.weight": "layers.{i}.mlp.down_proj.weight",
+}
+
+
+def load_gguf(path: str, dtype=None):
+    """.gguf file -> (LlamaConfig, params pytree), ready for the engine.
+
+    Only the llama architecture (which covers the Llama/Mistral GGUF
+    ecosystem the reference example served); other architectures raise.
+    """
+    import jax.numpy as jnp
+
+    from substratus_tpu.load.hf import convert_llama_state_dict
+    from substratus_tpu.models.llama import LlamaConfig
+
+    meta, tensors = read_gguf(path)
+    arch = meta.get("general.architecture")
+    if arch != "llama":
+        raise ValueError(
+            f"{path}: gguf architecture {arch!r} unsupported (llama only)"
+        )
+    p = "llama."
+    scaling = meta.get(p + "rope.scaling.type")
+    if scaling and scaling != "none":
+        # loud-not-silent: serving to an extended context with unscaled
+        # rope would produce garbage past the base window
+        raise ValueError(
+            f"{path}: rope scaling {scaling!r} is not supported — the "
+            "model would misbehave beyond its base context"
+        )
+    n_heads = int(meta[p + "attention.head_count"])
+    cfg = LlamaConfig(
+        vocab_size=int(tensors["token_embd.weight"].shape[0]),
+        dim=int(meta[p + "embedding_length"]),
+        n_layers=int(meta[p + "block_count"]),
+        n_heads=n_heads,
+        n_kv_heads=int(meta.get(p + "attention.head_count_kv", n_heads)),
+        head_dim=(
+            int(meta[p + "attention.key_length"])
+            if p + "attention.key_length" in meta else None
+        ),
+        hidden_dim=int(meta[p + "feed_forward_length"]),
+        max_seq_len=int(meta.get(p + "context_length", 4096)),
+        rope_theta=float(meta.get(p + "rope.freq_base", 10000.0)),
+        norm_eps=float(
+            meta.get(p + "attention.layer_norm_rms_epsilon", 1e-5)
+        ),
+        tie_embeddings="output.weight" not in tensors,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+    )
+
+    sd: Dict[str, np.ndarray] = {}
+    for gname, arr in tensors.items():
+        parts = gname.split(".")
+        if parts[0] == "blk":
+            i = parts[1]
+            key = ".".join(["blk", "{i}"] + parts[2:])
+            hf = _NAME_MAP.get(key)
+            if hf is None:
+                continue  # rope freq tables etc. — derived, not loaded
+            if parts[2] in ("attn_q", "attn_k"):
+                heads = cfg.n_heads if parts[2] == "attn_q" else cfg.n_kv_heads
+                arr = _unpermute_qk(arr, heads)
+            sd[hf.format(i=i)] = arr
+        else:
+            hf = _NAME_MAP.get(gname)
+            if hf is not None:
+                sd[hf] = arr
+    params = convert_llama_state_dict(sd, cfg, cfg.dtype)
+    return cfg, params
+
+
+class GGUFTokenizer:
+    """SentencePiece-BPE tokenizer from the GGUF-embedded vocab
+    (tokenizer.ggml.tokens/scores/token_type + bos/eos ids) — the same
+    greedy highest-score bigram merge llama.cpp's SPM tokenizer runs, so
+    a .gguf file serves standalone with its own real tokenizer.
+
+    Token types follow the sentencepiece proto: 1 normal, 2 unknown,
+    3 control (skipped on decode), 6 byte (`<0xXX>` pieces)."""
+
+    def __init__(self, meta: Dict[str, Any]):
+        t = "tokenizer.ggml."
+        self.tokens: List[str] = meta[t + "tokens"]
+        n = len(self.tokens)
+        self.scores = meta.get(t + "scores") or [0.0] * n
+        self.types = meta.get(t + "token_type") or [1] * n
+        self.bos_id = int(meta.get(t + "bos_token_id", 1))
+        self.eos_id = int(meta.get(t + "eos_token_id", 2))
+        self.unk_id = int(meta.get(t + "unknown_token_id", 0))
+        self.vocab_size = n
+        self._index = {tok: i for i, tok in enumerate(self.tokens)}
+        self._byte = {}
+        for i, (tok, ty) in enumerate(zip(self.tokens, self.types)):
+            if ty == 6 and tok.startswith("<0x") and tok.endswith(">"):
+                self._byte[int(tok[3:-1], 16)] = i
+
+    def encode(self, text: str) -> List[int]:
+        """Greedy highest-score bigram merge (llama.cpp llm_tokenizer_spm)
+        via a lazy-invalidated heap: O(n log n), safe on the request hot
+        path for long prompts."""
+        import heapq
+
+        # SP normalization: spaces become U+2581, with a leading one.
+        pieces = list("▁" + text.replace(" ", "▁"))
+        n = len(pieces)
+        prev = list(range(-1, n - 1))
+        nxt = list(range(1, n + 1))
+        alive = [True] * n
+
+        def push(heap, i):
+            j = nxt[i]
+            if j >= n:
+                return
+            cand = pieces[i] + pieces[j]
+            idx = self._index.get(cand)
+            if idx is not None:
+                # ties broken leftmost, like the linear scan
+                heapq.heappush(heap, (-self.scores[idx], i, cand, idx))
+
+        heap: List[Tuple[float, int, str, int]] = []
+        for i in range(n - 1):
+            push(heap, i)
+        while heap:
+            _, i, cand, idx = heapq.heappop(heap)
+            j = nxt[i] if i < n else n
+            # lazy invalidation: stale entries no longer describe the list
+            if not (i < n and alive[i] and j < n and alive[j]
+                    and pieces[i] + pieces[j] == cand):
+                continue
+            pieces[i] = cand
+            alive[j] = False
+            nxt[i] = nxt[j]
+            if nxt[j] < n:
+                prev[nxt[j]] = i
+            if prev[i] >= 0:
+                push(heap, prev[i])
+            push(heap, i)
+        out = [self.bos_id]
+        i = 0
+        while i < n:
+            if not alive[i]:
+                i += 1
+                continue
+            idx = self._index.get(pieces[i])
+            if idx is not None:
+                out.append(idx)
+            else:
+                for b in pieces[i].encode("utf-8"):  # byte fallback
+                    out.append(self._byte.get(b, self.unk_id))
+            i = nxt[i]
+        return out
+
+    def decode(self, ids: List[int]) -> str:
+        buf = bytearray()
+        for i in ids:
+            if not 0 <= i < self.vocab_size or self.types[i] == 3:
+                continue  # control tokens (bos/eos) don't render
+            if self.types[i] == 6:
+                buf += bytes([int(self.tokens[i][3:-1], 16)])
+            else:
+                buf += self.tokens[i].encode("utf-8")
+        text = buf.decode("utf-8", "replace").replace("▁", " ")
+        # strip exactly the ONE SentencePiece dummy-prefix space — more
+        # would eat real leading whitespace (indented code continuations)
+        return text[1:] if text.startswith(" ") else text
+
+
+class UnsupportedGGUFTokenizer(ValueError):
+    """The file embeds a vocab this importer can't drive (e.g. a BPE
+    'gpt2' vocab — Llama-3-era GGUFs). Serving with a byte fallback would
+    silently produce garbage, so callers must surface this."""
+
+
+def tokenizer_from_gguf(path: str):
+    """The embedded tokenizer of a .gguf file; None when the file carries
+    no vocab at all (smoke files). Raises UnsupportedGGUFTokenizer for a
+    vocab model we can't run — loud-not-silent, a mistokenized prompt is
+    garbage out with no error anywhere else."""
+    meta, _ = read_gguf(path, with_tensors=False)
+    model = meta.get("tokenizer.ggml.model")
+    if "tokenizer.ggml.tokens" not in meta and model is None:
+        return None
+    if model not in ("llama", "spm"):
+        raise UnsupportedGGUFTokenizer(
+            f"{path}: embedded tokenizer model {model!r} unsupported "
+            "(SentencePiece only) — place a tokenizer.json next to the "
+            "file to serve it"
+        )
+    if "tokenizer.ggml.tokens" not in meta:
+        return None
+    return GGUFTokenizer(meta)
+
+
+def resolve_gguf(path: str, strict: bool = False):
+    """The .gguf file behind a model path, or None for non-GGUF paths.
+
+    strict=True raises on the ambiguous/missing cases (a path explicitly
+    naming .gguf must exist; a dir with several .gguf files is a split
+    checkpoint we don't support); strict=False returns None for them —
+    the tokenizer resolver shares this so path semantics can't drift."""
+    import glob
+    import os
+
+    if path.endswith(".gguf"):
+        if os.path.isfile(path):
+            return path
+        if strict:
+            raise FileNotFoundError(f"no such file: {path}")
+        return None
+    if os.path.isdir(path):
+        found = sorted(glob.glob(os.path.join(path, "*.gguf")))
+        if len(found) > 1:
+            if strict:
+                raise ValueError(
+                    f"{path}: {len(found)} .gguf files found — pass the "
+                    "exact file (split/multi-shard GGUF is unsupported)"
+                )
+            return None
+        if found:
+            return found[0]
+    return None

@@ -34,6 +34,17 @@ def load_params_json(path: str = "/content/params.json") -> Dict[str, Any]:
     return {}
 
 
+def _resolve_gguf(path: str):
+    """Strict GGUF path resolution for --model: loud on missing files and
+    ambiguous multi-shard dirs (substratus_tpu.load.gguf.resolve_gguf)."""
+    from substratus_tpu.load.gguf import resolve_gguf
+
+    try:
+        return resolve_gguf(path, strict=True)
+    except (FileNotFoundError, ValueError) as e:
+        raise SystemExit(str(e))
+
+
 def resolve_kv_layout(params_json: Dict[str, Any]) -> str:
     """The decode_attn_impl="fused" kernel lives on the DENSE slot-cache
     path (update_cache_and_attend); paged decode has its own read path
@@ -132,8 +143,15 @@ def main(argv=None) -> int:
     from substratus_tpu.serve.tokenizer import load_tokenizer
 
     def load_checkpoint(path: str):
-        """Orbax artifact if present, else HF layout — one resolution rule
-        for target and draft models alike."""
+        """One resolution rule for target and draft models alike: a .gguf
+        file (or a mounted artifact dir holding one) loads through the
+        llama.cpp-format importer; otherwise orbax artifact if present,
+        else HF layout."""
+        gguf_path = _resolve_gguf(path)
+        if gguf_path is not None:
+            from substratus_tpu.load.gguf import load_gguf
+
+            return load_gguf(gguf_path)
         from substratus_tpu.train.checkpoints import maybe_restore_orbax
 
         restored = maybe_restore_orbax(path)

@@ -52,9 +52,32 @@ def load_tokenizer(path: str | None) -> Tokenizer:
         return ByteTokenizer()
     import os
 
+    # A GGUF checkpoint carries its own vocab: prefer the embedded
+    # SentencePiece tokenizer, then tokenizer files sitting next to it.
+    # An embedded vocab we CANNOT run (BPE) is only an error when no
+    # sibling tokenizer files can stand in.
+    from substratus_tpu.load.gguf import (
+        UnsupportedGGUFTokenizer, resolve_gguf, tokenizer_from_gguf,
+    )
+
+    gguf = resolve_gguf(path)
+    unsupported: UnsupportedGGUFTokenizer | None = None
+    if gguf is not None:
+        try:
+            tok = tokenizer_from_gguf(gguf)
+        except UnsupportedGGUFTokenizer as e:
+            tok, unsupported = None, e
+        if tok is not None:
+            return tok
+        path = os.path.dirname(gguf) or "."
+
     if os.path.isdir(path) and any(
         os.path.exists(os.path.join(path, f))
         for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
     ):
         return HFTokenizer(path)
+    if unsupported is not None:
+        # no stand-in found: serving raw bytes against a real vocab would
+        # be silent garbage — fail with the actionable message instead
+        raise SystemExit(str(unsupported))
     return ByteTokenizer()

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int manifests api-docs protogen nbwatch bench graft image install-manifests
+.PHONY: test test-int manifests api-docs protogen nbwatch spm bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -25,6 +25,11 @@ protogen:
 
 nbwatch:
 	g++ -O2 -Wall -o native/nbwatch native/nbwatch.cc
+
+# C++ SentencePiece encoder for the serving hot path (ctypes-loaded;
+# pure-Python fallback when absent).
+spm:
+	g++ -O2 -Wall -shared -fPIC -o native/libspm_tokenizer.so native/spm_tokenizer.cc
 
 bench:
 	$(PY) bench.py

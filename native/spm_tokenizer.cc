@@ -1,0 +1,148 @@
+// SentencePiece-BPE greedy-merge encoder — the serving hot path in C++.
+//
+// SURVEY.md §2.3 anticipated exactly this native component ("a C++
+// tokenizer/serving hot path"): prompt tokenization runs per API request
+// on the host while the TPU decodes, so it must not contend in Python.
+// Implements the same algorithm as the Python reference
+// (substratus_tpu/load/gguf.py::GGUFTokenizer.encode — llama.cpp's
+// llm_tokenizer_spm): split UTF-8 into code points, repeatedly merge the
+// adjacent pair whose concatenation is the highest-scoring vocab piece
+// (lazy-invalidated heap), then byte-fallback for leftovers. The two
+// implementations are locked together by tests/test_spm_native.py.
+//
+// Build: make spm  (g++ -O2 -shared -fPIC -> native/libspm_tokenizer.so)
+// ABI: plain C, driven from Python via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> index;
+  std::vector<float> scores;
+  int32_t byte_ids[256];
+  int32_t unk_id;
+};
+
+struct Cand {
+  float score;
+  int32_t left;
+  std::string text;  // expected concatenation (validity check)
+  int32_t id;
+};
+
+struct CandLess {
+  bool operator()(const Cand& a, const Cand& b) const {
+    if (a.score != b.score) return a.score < b.score;  // max-heap on score
+    return a.left > b.left;                            // ties: leftmost
+  }
+};
+
+size_t utf8_len(unsigned char c) {
+  if (c < 0x80) return 1;
+  if ((c >> 5) == 0x6) return 2;
+  if ((c >> 4) == 0xE) return 3;
+  if ((c >> 3) == 0x1E) return 4;
+  return 1;  // invalid byte: treat as one unit
+}
+
+}  // namespace
+
+extern "C" {
+
+// tokens: n utf-8 strings; scores: n floats; byte_ids: 256 ids (-1 =
+// absent); unk_id: fallback id. Returns an opaque handle.
+void* spm_create(const char** tokens, const float* scores, int32_t n,
+                 const int32_t* byte_ids, int32_t unk_id) {
+  auto* v = new Vocab();
+  v->scores.assign(scores, scores + n);
+  v->index.reserve(n * 2);
+  // last-wins on duplicate pieces, matching the Python dict comprehension
+  for (int32_t i = 0; i < n; ++i) v->index[tokens[i]] = i;
+  std::memcpy(v->byte_ids, byte_ids, sizeof(v->byte_ids));
+  v->unk_id = unk_id;
+  // `tokens` stays owned by the caller (ctypes array); strings were
+  // copied into the index above.
+  return v;
+}
+
+void spm_destroy(void* handle) { delete static_cast<Vocab*>(handle); }
+
+// text: utf-8 of text_len bytes (already SP-normalized by the caller:
+// spaces -> U+2581, leading U+2581; may contain NUL bytes — the length
+// is explicit for exactly that reason). Writes up to max_out ids;
+// returns the count (callers size max_out at text_len + 1, the worst
+// case).
+int32_t spm_encode(void* handle, const char* text, int32_t text_len,
+                   int32_t* out, int32_t max_out) {
+  const Vocab& v = *static_cast<Vocab*>(handle);
+  const size_t len = static_cast<size_t>(text_len);
+
+  // Split into code points (symbol = [begin, end) into `text`).
+  std::vector<std::string> piece;
+  std::vector<int32_t> next, prev;
+  for (size_t i = 0; i < len;) {
+    size_t n = utf8_len(static_cast<unsigned char>(text[i]));
+    if (i + n > len) n = 1;
+    piece.emplace_back(text + i, n);
+    i += n;
+  }
+  const int32_t m = static_cast<int32_t>(piece.size());
+  next.resize(m);
+  prev.resize(m);
+  std::vector<char> alive(m, 1);
+  for (int32_t i = 0; i < m; ++i) {
+    next[i] = i + 1;
+    prev[i] = i - 1;
+  }
+
+  std::priority_queue<Cand, std::vector<Cand>, CandLess> heap;
+  auto push = [&](int32_t i) {
+    const int32_t j = next[i];
+    if (j >= m) return;
+    std::string cand = piece[i] + piece[j];
+    auto it = v.index.find(cand);
+    if (it != v.index.end())
+      heap.push(Cand{v.scores[it->second], i, std::move(cand), it->second});
+  };
+  for (int32_t i = 0; i + 1 < m; ++i) push(i);
+
+  while (!heap.empty()) {
+    Cand c = heap.top();
+    heap.pop();
+    const int32_t i = c.left;
+    if (i >= m || !alive[i]) continue;
+    const int32_t j = next[i];
+    if (j >= m || !alive[j]) continue;
+    if (piece[i] + piece[j] != c.text) continue;  // stale entry
+    piece[i] = std::move(c.text);
+    alive[j] = 0;
+    next[i] = next[j];
+    if (next[j] < m) prev[next[j]] = i;
+    if (prev[i] >= 0) push(prev[i]);
+    push(i);
+  }
+
+  int32_t count = 0;
+  for (int32_t i = 0; i < m && count < max_out; i = next[i]) {
+    if (!alive[i]) continue;
+    auto it = v.index.find(piece[i]);
+    if (it != v.index.end()) {
+      out[count++] = it->second;
+      continue;
+    }
+    for (unsigned char b : piece[i]) {  // byte fallback
+      if (count >= max_out) break;
+      const int32_t id = v.byte_ids[b];
+      out[count++] = id >= 0 ? id : v.unk_id;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -330,11 +330,42 @@ class GGUFTokenizer:
         for i, (tok, ty) in enumerate(zip(self.tokens, self.types)):
             if ty == 6 and tok.startswith("<0x") and tok.endswith(">"):
                 self._byte[int(tok[3:-1], 16)] = i
+        self._native = None
+        lib = _native_spm()
+        if lib is not None:
+            import ctypes
+
+            toks = (ctypes.c_char_p * n)(
+                *[t.encode("utf-8") for t in self.tokens]
+            )
+            scores = (ctypes.c_float * n)(*[float(s) for s in self.scores])
+            byte_ids = (ctypes.c_int32 * 256)(
+                *[self._byte.get(b, -1) for b in range(256)]
+            )
+            handle = lib.spm_create(toks, scores, n, byte_ids, self.unk_id)
+            if handle:
+                import weakref
+
+                self._native = (lib, handle)
+                # free the C++ vocab copy with the tokenizer object
+                weakref.finalize(self, lib.spm_destroy, handle)
 
     def encode(self, text: str) -> List[int]:
         """Greedy highest-score bigram merge (llama.cpp llm_tokenizer_spm)
         via a lazy-invalidated heap: O(n log n), safe on the request hot
-        path for long prompts."""
+        path for long prompts. Uses the C++ encoder when built (make spm;
+        native/spm_tokenizer.cc — same algorithm, locked together by
+        tests/test_spm_native.py)."""
+        if self._native is not None:
+            import ctypes
+
+            lib, handle = self._native
+            norm = ("▁" + text.replace(" ", "▁")).encode("utf-8")
+            out = (ctypes.c_int32 * (len(norm) + 1))()
+            count = lib.spm_encode(
+                handle, norm, len(norm), out, len(norm) + 1
+            )
+            return [self.bos_id] + list(out[:count])
         import heapq
 
         # SP normalization: spaces become U+2581, with a leading one.
@@ -400,6 +431,50 @@ class GGUFTokenizer:
         # strip exactly the ONE SentencePiece dummy-prefix space — more
         # would eat real leading whitespace (indented code continuations)
         return text[1:] if text.startswith(" ") else text
+
+
+_SPM_LIB = "unloaded"
+
+
+def _native_spm():
+    """ctypes handle to the C++ SPM encoder (native/spm_tokenizer.cc),
+    or None — pure Python stands in when the .so isn't built or
+    SUBSTRATUS_SPM_NATIVE=0."""
+    import ctypes
+    import os
+
+    # env toggle is NOT cached: tests (and operators) flip it at runtime
+    if os.environ.get("SUBSTRATUS_SPM_NATIVE") == "0":
+        return None
+    global _SPM_LIB
+    if _SPM_LIB != "unloaded":
+        return _SPM_LIB
+    _SPM_LIB = None
+    so = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "native", "libspm_tokenizer.so",
+    )
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.spm_create.restype = ctypes.c_void_p
+    lib.spm_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.spm_encode.restype = ctypes.c_int32
+    lib.spm_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.spm_destroy.restype = None
+    lib.spm_destroy.argtypes = [ctypes.c_void_p]
+    _SPM_LIB = lib
+    return lib
 
 
 class UnsupportedGGUFTokenizer(ValueError):

@@ -1,0 +1,86 @@
+"""C++ SPM encoder (native/spm_tokenizer.cc) vs the Python reference.
+
+The two implementations of llama.cpp's greedy bigram merge must produce
+IDENTICAL ids for any input — the native one serves the request hot
+path, the Python one is the fallback and the specification.
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(REPO, "native", "libspm_tokenizer.so")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    subprocess.run(["make", "spm"], cwd=REPO, check=True,
+                   capture_output=True)
+    assert os.path.exists(SO)
+    # reset the module-level lib cache so this process picks it up
+    from substratus_tpu.load import gguf
+
+    gguf._SPM_LIB = "unloaded"
+    yield
+    gguf._SPM_LIB = "unloaded"
+
+
+def _tok(native: bool):
+    from test_gguf import _tok_meta
+
+    from substratus_tpu.load import gguf
+
+    os.environ["SUBSTRATUS_SPM_NATIVE"] = "1" if native else "0"
+    gguf._SPM_LIB = "unloaded"
+    try:
+        t = gguf.GGUFTokenizer(_tok_meta())
+        if native:
+            assert t._native is not None, "native encoder did not load"
+        else:
+            assert t._native is None
+        return t
+    finally:
+        os.environ.pop("SUBSTRATUS_SPM_NATIVE", None)
+
+
+CASES = [
+    "hello world",
+    "a\x00b",                 # embedded NUL must not truncate
+    "hello world hello world hello",
+    "",
+    " ",
+    "héllo wörld",            # byte fallback for unknown code points
+    "  double  spaces  ",
+    "hello" * 50 + " world",
+    "日本語テキスト",           # fully byte-fallback
+]
+
+
+def test_native_matches_python_exactly():
+    py = _tok(False)
+    cc = _tok(True)
+    for text in CASES:
+        assert cc.encode(text) == py.encode(text), text
+
+
+def test_native_round_trips_through_decode():
+    cc = _tok(True)
+    for text in CASES:
+        got = cc.decode(cc.encode(text))
+        assert got == text, (text, got)
+
+
+def test_native_long_prompt_fast():
+    import time
+
+    cc = _tok(True)
+    text = "hello world " * 5000
+    t0 = time.perf_counter()
+    ids = cc.encode(text)
+    dt = time.perf_counter() - t0
+    assert dt < 0.5, f"native encode took {dt:.2f}s"
+    assert len(ids) > 1

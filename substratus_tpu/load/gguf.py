@@ -324,6 +324,9 @@ class GGUFTokenizer:
         self.bos_id = int(meta.get(t + "bos_token_id", 1))
         self.eos_id = int(meta.get(t + "eos_token_id", 2))
         self.unk_id = int(meta.get(t + "unknown_token_id", 0))
+        self.chat_template = meta.get("tokenizer.chat_template")
+        self._compiled_template = None
+        self._special_re = None
         self.vocab_size = n
         self._index = {tok: i for i, tok in enumerate(self.tokens)}
         self._byte = {}
@@ -351,25 +354,32 @@ class GGUFTokenizer:
                 weakref.finalize(self, lib.spm_destroy, handle)
 
     def encode(self, text: str) -> List[int]:
+        """BOS + greedy merge of the SP-normalized text (spaces->U+2581,
+        one dummy prefix)."""
+        return [self.bos_id] + self._encode_norm(
+            "▁" + text.replace(" ", "▁")
+        )
+
+    def _encode_norm(self, norm: str) -> List[int]:
         """Greedy highest-score bigram merge (llama.cpp llm_tokenizer_spm)
-        via a lazy-invalidated heap: O(n log n), safe on the request hot
-        path for long prompts. Uses the C++ encoder when built (make spm;
+        of an ALREADY-normalized piece string, no BOS, via a
+        lazy-invalidated heap: O(n log n), safe on the request hot path
+        for long prompts. Uses the C++ encoder when built (make spm;
         native/spm_tokenizer.cc — same algorithm, locked together by
         tests/test_spm_native.py)."""
         if self._native is not None:
             import ctypes
 
+            raw = norm.encode("utf-8")
             lib, handle = self._native
-            norm = ("▁" + text.replace(" ", "▁")).encode("utf-8")
-            out = (ctypes.c_int32 * (len(norm) + 1))()
+            out = (ctypes.c_int32 * (len(raw) + 1))()
             count = lib.spm_encode(
-                handle, norm, len(norm), out, len(norm) + 1
+                handle, raw, len(raw), out, len(raw) + 1
             )
-            return [self.bos_id] + list(out[:count])
+            return list(out[:count])
         import heapq
 
-        # SP normalization: spaces become U+2581, with a leading one.
-        pieces = list("▁" + text.replace(" ", "▁"))
+        pieces = list(norm)
         n = len(pieces)
         prev = list(range(-1, n - 1))
         nxt = list(range(1, n + 1))
@@ -403,7 +413,7 @@ class GGUFTokenizer:
             if prev[i] >= 0:
                 push(heap, prev[i])
             push(heap, i)
-        out = [self.bos_id]
+        out: List[int] = []
         i = 0
         while i < n:
             if not alive[i]:
@@ -416,6 +426,79 @@ class GGUFTokenizer:
                 for b in pieces[i].encode("utf-8"):  # byte fallback
                     out.append(self._byte.get(b, self.unk_id))
             i = nxt[i]
+        return out
+
+    def apply_chat_template(self, messages):
+        """Render with the GGUF's embedded jinja chat template (the
+        format the checkpoint was trained on; tokenizer.chat_template).
+        Returns None when the file carries no template (callers fall back
+        to the generic transcript)."""
+        if not self.chat_template:
+            return None
+        if self._compiled_template is None:
+            # Sandboxed: the template ships inside a downloaded model
+            # file — same posture transformers takes. Compiled ONCE (this
+            # runs per chat request); helpers transformers guarantees
+            # (raise_exception, strftime_now, tojson) provided so real
+            # Mistral/Zephyr/Llama-3 templates render.
+            import datetime
+            import json as _json
+
+            from jinja2.sandbox import ImmutableSandboxedEnvironment
+
+            env = ImmutableSandboxedEnvironment(
+                keep_trailing_newline=True, autoescape=False,
+            )
+
+            def raise_exception(message):
+                raise ValueError(f"chat template error: {message}")
+
+            env.globals["raise_exception"] = raise_exception
+            env.globals["strftime_now"] = (
+                lambda fmt: datetime.datetime.now().strftime(fmt)
+            )
+            env.filters["tojson"] = lambda v, **kw: _json.dumps(v, **kw)
+            self._compiled_template = env.from_string(self.chat_template)
+        bos = self.tokens[self.bos_id] if self.bos_id < self.vocab_size else ""
+        eos = self.tokens[self.eos_id] if self.eos_id < self.vocab_size else ""
+        return self._compiled_template.render(
+            messages=messages, add_generation_prompt=True,
+            bos_token=bos, eos_token=eos,
+        )
+
+    def encode_templated(self, text: str) -> List[int]:
+        """Encode a TEMPLATE-RENDERED prompt: control-token strings the
+        template injected ('<s>', '<|im_start|>', ...) map to their ids
+        instead of being SPM-merged as literal characters, and no BOS is
+        auto-prepended beyond what the template itself rendered
+        (llama.cpp's tokenize with parse_special=true)."""
+        import re
+
+        if self._special_re is None:
+            specials = sorted(
+                (t for t, ty in zip(self.tokens, self.types) if ty == 3),
+                key=len, reverse=True,
+            )
+            self._special_re = re.compile(
+                "(" + "|".join(map(re.escape, specials)) + ")"
+            ) if specials else re.compile(r"(?!x)x")  # never matches
+        out: List[int] = []
+        first_segment = True
+        for part in self._special_re.split(text):
+            if not part:
+                continue
+            idx = self._index.get(part)
+            if idx is not None and self.types[idx] == 3:
+                out.append(idx)
+                first_segment = False
+                continue
+            # SP-normalize the segment; the dummy ▁ prefix applies only
+            # at the very start of raw text, never mid-template
+            norm = part.replace(" ", "▁")
+            if first_segment:
+                norm = "▁" + norm
+                first_segment = False
+            out.extend(self._encode_norm(norm))
         return out
 
     def decode(self, ids: List[int]) -> str:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import math
 import time
 import uuid
@@ -31,6 +32,44 @@ class ServerState:
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.ready = True
+
+    def render_chat(self, messages):
+        """Messages -> (prompt, templated) using the MODEL'S chat
+        template when the tokenizer carries one (HF apply_chat_template,
+        or a GGUF's embedded jinja tokenizer.chat_template) — chat
+        checkpoints are trained on their template and degrade badly off
+        it. `templated` tells encoding to parse the special tokens the
+        template rendered and skip the automatic BOS (the template
+        already placed one). Falls back to the generic role-joined
+        transcript, loudly when a template EXISTS but fails."""
+        tmpl = getattr(self.tokenizer, "apply_chat_template", None)
+        if tmpl is not None:
+            try:
+                rendered = tmpl(messages)
+            except Exception:  # noqa: BLE001 — a broken template must
+                # not take down the endpoint, but silence here would
+                # serve off-format prompts with no trace
+                logging.getLogger(__name__).exception(
+                    "chat template failed; using the generic transcript"
+                )
+                rendered = None
+            if rendered is not None:
+                return rendered, True
+        prompt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in messages
+        )
+        return prompt + "\nassistant:", False
+
+    def encode_prompt(self, prompt: str, templated: bool = False):
+        """Prompt -> ids; template-rendered prompts use the tokenizer's
+        special-token-aware path (no doubled BOS, control tokens as ids)
+        when it has one."""
+        if templated:
+            enc = getattr(self.tokenizer, "encode_templated", None)
+            if enc is not None:
+                return enc(prompt)
+        return self.tokenizer.encode(prompt)
 
 
 def _find_stop(text: str, stop) -> Optional[int]:
@@ -228,10 +267,10 @@ def build_app(state: ServerState) -> web.Application:
                         text="'top_p' must be in (0, 1]"
                     )
 
-    def _submit(prompt: str, body: dict) -> Request:
+    def _submit(prompt: str, body: dict, templated: bool = False) -> Request:
         tok = state.tokenizer
         req = Request(
-            prompt_tokens=tok.encode(prompt),
+            prompt_tokens=state.encode_prompt(prompt, templated),
             max_tokens=int(body.get("max_tokens", 16)),
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
@@ -240,8 +279,9 @@ def build_app(state: ServerState) -> web.Application:
         )
         return state.engine.submit(req)
 
-    async def _generate(request: web.Request, prompt: str, body: dict):
-        req = _submit(prompt, body)
+    async def _generate(request: web.Request, prompt: str, body: dict,
+                        templated: bool = False):
+        req = _submit(prompt, body, templated)
         stop = body.get("stop")
         if isinstance(stop, str):
             stop = [stop]
@@ -261,12 +301,13 @@ def build_app(state: ServerState) -> web.Application:
         return text, len(req.prompt_tokens), len(gen_ids), req.finish_reason
 
     async def _stream(
-        request: web.Request, prompt: str, body: dict, chat: bool
+        request: web.Request, prompt: str, body: dict, chat: bool,
+        templated: bool = False,
     ) -> web.StreamResponse:
         """OpenAI-style SSE streaming: one data: chunk per decoded token,
         then [DONE]. The engine already streams per-token through the
         request queue; this just relays it."""
-        req = _submit(prompt, body)
+        req = _submit(prompt, body, templated)
         if state.engine.error is not None:
             raise web.HTTPInternalServerError(text=str(state.engine.error))
         stop = body.get("stop")
@@ -398,13 +439,14 @@ def build_app(state: ServerState) -> web.Application:
             raise web.HTTPBadRequest(text="invalid JSON body")
         _validate_body(body)
         messages = body.get("messages") or []
-        prompt = "\n".join(
-            f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
-        )
-        prompt += "\nassistant:"
+        prompt, templated = state.render_chat(messages)
         if body.get("stream"):
-            return await _stream(request, prompt, body, chat=True)
-        text, n_prompt, n_gen, finish = await _generate(request, prompt, body)
+            return await _stream(
+                request, prompt, body, chat=True, templated=templated
+            )
+        text, n_prompt, n_gen, finish = await _generate(
+            request, prompt, body, templated
+        )
         resp = _completion_body(state, text, n_prompt, n_gen, finish)
         resp["object"] = "chat.completion"
         resp["choices"] = [

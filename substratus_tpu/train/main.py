@@ -78,13 +78,27 @@ def main(argv=None) -> int:
     )
     params = None
     if model_dir:
-        restored = maybe_restore_orbax(model_dir)
-        if restored is not None:
-            cfg, params = restored
-        else:
-            from substratus_tpu.load.hf import load_pretrained
+        from substratus_tpu.load.gguf import resolve_gguf
 
-            cfg, params = load_pretrained(model_dir)
+        try:
+            gguf_path = resolve_gguf(model_dir, strict=True)
+        except (FileNotFoundError, ValueError) as e:
+            # same one-line exit the serve entrypoint gives (serve/main.py)
+            raise SystemExit(str(e))
+        if gguf_path is not None:
+            # fine-tune straight off a llama.cpp checkpoint (same importer
+            # serving uses; weights dequantize to the training dtype)
+            from substratus_tpu.load.gguf import load_gguf
+
+            cfg, params = load_gguf(gguf_path)
+        else:
+            restored = maybe_restore_orbax(model_dir)
+            if restored is not None:
+                cfg, params = restored
+            else:
+                from substratus_tpu.load.hf import load_pretrained
+
+                cfg, params = load_pretrained(model_dir)
         tokenizer = load_tokenizer(model_dir)
     else:
         from substratus_tpu.models import registry

@@ -7,6 +7,7 @@ Q4_0/Q8_0 block quantization — then assert load_gguf returns the same
 params convert_llama_state_dict produces from the HF originals, and
 that the model actually generates through the engine.
 """
+import os
 import struct
 
 import jax
@@ -525,3 +526,36 @@ def test_templated_encode_parses_specials_no_double_bos():
     state.tokenizer = GGUFTokenizer(meta)
     out, templated = state.render_chat([{"role": "user", "content": "x"}])
     assert templated and out.startswith("2")
+
+
+def test_train_from_gguf_base(tmp_path):
+    """A GGUF file works as the training base: `python -m
+    substratus_tpu.train.main --model base.gguf` runs LoRA steps and
+    saves an artifact (the reference's train flow consumed HF bases
+    only; here the llama.cpp ecosystem feeds training too)."""
+    import subprocess
+    import sys
+
+    sd = _hf_weights(jax.random.key(0))
+    base = tmp_path / "base.gguf"
+    _write_gguf(base, _tok_meta(), _gguf_tensors(sd, lambda g: 0))
+    out_dir = tmp_path / "out"
+    params = tmp_path / "params.json"
+    params.write_text(
+        '{"steps": 2, "batch_size": 2, "seq_len": 32, "lora_rank": 2}'
+    )
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "all.jsonl").write_text(
+        '{"text": "hello world hello world"}\n' * 8
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "substratus_tpu.train.main",
+         "--model", str(base), "--out", str(out_dir),
+         "--params", str(params), "--data", str(data_dir)],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert out_dir.exists()

@@ -560,6 +560,57 @@ def _native_spm():
     return lib
 
 
+def write_tokenizer_gguf(path: str, meta: Dict[str, Any]) -> bool:
+    """Write a metadata-only .gguf holding a source file's tokenizer.* (+
+    architecture) keys — the artifact-sidecar form of the embedded vocab,
+    so a converted orbax artifact still serves with the model's real
+    tokenizer (load_tokenizer resolves any *.gguf in the artifact dir,
+    metadata-only). Returns False when the source had no tokenizer."""
+    keep = {
+        k: v for k, v in meta.items()
+        if k.startswith("tokenizer.") or k == "general.architecture"
+    }
+    if "tokenizer.ggml.tokens" not in keep:
+        return False
+
+    def s(x: str) -> bytes:
+        b = x.encode("utf-8")
+        return struct.pack("<Q", len(b)) + b
+
+    def value(v) -> bytes:
+        if isinstance(v, bool):
+            return struct.pack("<I", 7) + struct.pack("?", v)
+        if isinstance(v, str):
+            return struct.pack("<I", _T_STRING) + s(v)
+        if isinstance(v, float):
+            return struct.pack("<I", 6) + struct.pack("<f", v)
+        if isinstance(v, int):
+            return struct.pack("<I", 5) + struct.pack("<i", v)
+        if isinstance(v, list):
+            if all(isinstance(e, str) for e in v):
+                etype, enc = _T_STRING, s
+            elif all(isinstance(e, int) and not isinstance(e, bool)
+                     for e in v):
+                etype, enc = 5, lambda e: struct.pack("<i", e)
+            else:
+                etype, enc = 6, lambda e: struct.pack("<f", float(e))
+            return (
+                struct.pack("<I", _T_ARRAY) + struct.pack("<I", etype)
+                + struct.pack("<Q", len(v))
+                + b"".join(enc(e) for e in v)
+            )
+        raise ValueError(f"gguf: cannot serialize metadata value {v!r}")
+
+    buf = bytearray()
+    buf += GGUF_MAGIC + struct.pack("<I", 3)
+    buf += struct.pack("<Q", 0) + struct.pack("<Q", len(keep))  # 0 tensors
+    for k, v in keep.items():
+        buf += s(k) + value(v)
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+    return True
+
+
 class UnsupportedGGUFTokenizer(ValueError):
     """The file embeds a vocab this importer can't drive (e.g. a BPE
     'gpt2' vocab — Llama-3-era GGUFs). Serving with a byte fallback would
@@ -584,6 +635,15 @@ def tokenizer_from_gguf(path: str):
     if "tokenizer.ggml.tokens" not in meta:
         return None
     return GGUFTokenizer(meta)
+
+
+def resolve_gguf_or_exit(path: str):
+    """resolve_gguf(strict=True) with the one-line SystemExit every
+    entrypoint (load/train/serve) wants instead of a traceback."""
+    try:
+        return resolve_gguf(path, strict=True)
+    except (FileNotFoundError, ValueError) as e:
+        raise SystemExit(str(e))
 
 
 def resolve_gguf(path: str, strict: bool = False):

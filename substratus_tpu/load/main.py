@@ -45,10 +45,24 @@ def main(argv=None) -> int:
     from substratus_tpu.models import llama
     from substratus_tpu.train.checkpoints import save_artifact
 
+    gguf_path = None
     if name:
-        from substratus_tpu.load.hf import load_pretrained
+        from substratus_tpu.load.gguf import load_gguf, resolve_gguf_or_exit
 
-        cfg, params = load_pretrained(name)
+        gguf_path = resolve_gguf_or_exit(name)
+        if gguf_path is not None:
+            # llama.cpp checkpoint file -> orbax artifact (same importer
+            # serving and training use; load/gguf.py). Its ValueErrors
+            # (non-llama arch, rope scaling) exit cleanly like the
+            # resolver's.
+            try:
+                cfg, params = load_gguf(gguf_path)
+            except ValueError as e:
+                raise SystemExit(str(e))
+        else:
+            from substratus_tpu.load.hf import load_pretrained
+
+            cfg, params = load_pretrained(name)
         meta = {"source": name}
     else:
         # Weightless smoke import (reference parallel: opt-125m CPU smoke);
@@ -74,7 +88,18 @@ def main(argv=None) -> int:
     save_artifact(args.out, params, cfg, extra_meta=meta)
 
     # Ship tokenizer artifacts alongside the weights so serving needs no
-    # network access.
+    # network access. A GGUF source carries its vocab in metadata: export
+    # it as a metadata-only tokenizer.gguf sidecar (load_tokenizer
+    # resolves it) — without this the converted artifact would silently
+    # serve with the byte fallback.
+    if gguf_path is not None:
+        from substratus_tpu.load.gguf import read_gguf, write_tokenizer_gguf
+
+        src_meta, _ = read_gguf(gguf_path, with_tensors=False)
+        if write_tokenizer_gguf(
+            os.path.join(args.out, "tokenizer.gguf"), src_meta
+        ):
+            print("embedded tokenizer exported to tokenizer.gguf")
     if name and os.path.isdir(name):
         for fname in (
             "tokenizer.json", "tokenizer.model", "tokenizer_config.json",

@@ -36,13 +36,10 @@ def load_params_json(path: str = "/content/params.json") -> Dict[str, Any]:
 
 def _resolve_gguf(path: str):
     """Strict GGUF path resolution for --model: loud on missing files and
-    ambiguous multi-shard dirs (substratus_tpu.load.gguf.resolve_gguf)."""
-    from substratus_tpu.load.gguf import resolve_gguf
+    ambiguous multi-shard dirs (substratus_tpu.load.gguf)."""
+    from substratus_tpu.load.gguf import resolve_gguf_or_exit
 
-    try:
-        return resolve_gguf(path, strict=True)
-    except (FileNotFoundError, ValueError) as e:
-        raise SystemExit(str(e))
+    return resolve_gguf_or_exit(path)
 
 
 def resolve_kv_layout(params_json: Dict[str, Any]) -> str:

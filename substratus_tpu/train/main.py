@@ -78,13 +78,9 @@ def main(argv=None) -> int:
     )
     params = None
     if model_dir:
-        from substratus_tpu.load.gguf import resolve_gguf
+        from substratus_tpu.load.gguf import resolve_gguf_or_exit
 
-        try:
-            gguf_path = resolve_gguf(model_dir, strict=True)
-        except (FileNotFoundError, ValueError) as e:
-            # same one-line exit the serve entrypoint gives (serve/main.py)
-            raise SystemExit(str(e))
+        gguf_path = resolve_gguf_or_exit(model_dir)
         if gguf_path is not None:
             # fine-tune straight off a llama.cpp checkpoint (same importer
             # serving uses; weights dequantize to the training dtype)

@@ -559,3 +559,41 @@ def test_train_from_gguf_base(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert out_dir.exists()
+
+
+def test_loader_converts_gguf_to_artifact(tmp_path):
+    """The load job turns a .gguf into a servable orbax artifact (the
+    reference's gguf example imported through llama.cpp images; here the
+    same importer backs load, train, and serve)."""
+    import subprocess
+    import sys
+
+    sd = _hf_weights(jax.random.key(0))
+    base = tmp_path / "model.gguf"
+    _write_gguf(base, _tok_meta(), _gguf_tensors(sd, lambda g: 0))
+    out = tmp_path / "artifacts"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "substratus_tpu.load.main",
+         "--name", str(base), "--out", str(out)],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    from substratus_tpu.train.checkpoints import maybe_restore_orbax
+
+    restored = maybe_restore_orbax(str(out))
+    assert restored is not None
+    cfg, params = restored
+    assert cfg.n_layers == LAYERS and cfg.dim == DIM
+
+    # the embedded tokenizer must SURVIVE conversion: a converted
+    # artifact serving with the byte fallback would be silent garbage
+    from substratus_tpu.load.gguf import GGUFTokenizer
+    from substratus_tpu.serve.tokenizer import load_tokenizer
+
+    assert (out / "tokenizer.gguf").exists()
+    tok = load_tokenizer(str(out))
+    assert isinstance(tok, GGUFTokenizer)
+    assert tok.eos_id == 2
+    assert _VOCAB_TOKENS.index("▁hello") in tok.encode("hello")

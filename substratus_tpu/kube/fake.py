@@ -125,6 +125,30 @@ class FakeKube(KubeClient):
                     "change fields other than image, tolerations, or "
                     "deadlines"
                 )
+            # The apiserver only allows ADDING tolerations: every existing
+            # toleration must still match some entry in the new list,
+            # compared with tolerationSeconds excluded (apiserver
+            # validateOnlyAddedTolerations) — reordering and
+            # tolerationSeconds changes are allowed, removal/modification
+            # is not.
+            def _tol_key(t: Obj):
+                return tuple(
+                    sorted(
+                        (k, v) for k, v in t.items()
+                        if k != "tolerationSeconds"
+                    )
+                )
+
+            new_keys = {
+                _tol_key(t) for t in new_spec.get("tolerations") or []
+            }
+            for t in old_spec.get("tolerations") or []:
+                if _tol_key(t) not in new_keys:
+                    raise Invalid(
+                        f"Pod {new['metadata']['name']}: spec.tolerations: "
+                        "existing tolerations may not be modified or "
+                        "removed, only new tolerations may be added"
+                    )
         elif kind in ("ConfigMap", "Secret"):
             if current.get("immutable") and (
                 new.get("data") != current.get("data")

@@ -637,6 +637,22 @@ def tokenizer_from_gguf(path: str):
     return GGUFTokenizer(meta)
 
 
+def gguf_has_tensors(path: str) -> bool:
+    """False only for a VALID gguf header declaring zero tensors — the
+    metadata-only tokenizer sidecar write_tokenizer_gguf leaves inside
+    converted artifacts. Unreadable/corrupt files return True so they
+    still route to read_gguf, whose bad-magic error is the clearer one.
+    Header: magic(4) version(4) tensor_count(8)."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(16)
+        if len(head) < 16 or head[:4] != GGUF_MAGIC:
+            return True
+        return struct.unpack("<Q", head[8:16])[0] > 0
+    except OSError:
+        return True
+
+
 def resolve_gguf_or_exit(path: str):
     """resolve_gguf(strict=True) with the one-line SystemExit every
     entrypoint (load/train/serve) wants instead of a traceback."""
@@ -646,24 +662,39 @@ def resolve_gguf_or_exit(path: str):
         raise SystemExit(str(e))
 
 
-def resolve_gguf(path: str, strict: bool = False):
+def resolve_gguf(path: str, strict: bool = False, weights: bool = True):
     """The .gguf file behind a model path, or None for non-GGUF paths.
 
     strict=True raises on the ambiguous/missing cases (a path explicitly
     naming .gguf must exist; a dir with several .gguf files is a split
     checkpoint we don't support); strict=False returns None for them —
-    the tokenizer resolver shares this so path semantics can't drift."""
+    the tokenizer resolver shares this so path semantics can't drift.
+
+    weights=True (the checkpoint path) ignores metadata-only files when
+    scanning a directory — a converted orbax artifact holds a
+    tokenizer.gguf sidecar that must not shadow the orbax weights — and
+    raises on an explicitly named metadata-only file. The tokenizer
+    resolver passes weights=False: the sidecar is exactly what it wants."""
     import glob
     import os
 
     if path.endswith(".gguf"):
         if os.path.isfile(path):
+            if weights and not gguf_has_tensors(path):
+                if strict:
+                    raise ValueError(
+                        f"{path}: metadata-only GGUF (no tensors) — this is "
+                        "a tokenizer sidecar, not a weight checkpoint"
+                    )
+                return None
             return path
         if strict:
             raise FileNotFoundError(f"no such file: {path}")
         return None
     if os.path.isdir(path):
         found = sorted(glob.glob(os.path.join(path, "*.gguf")))
+        if weights:
+            found = [f for f in found if gguf_has_tensors(f)]
         if len(found) > 1:
             if strict:
                 raise ValueError(

@@ -270,6 +270,14 @@ def update_cache_and_attend(
         # projections (ops/fused_decode.py).
         from substratus_tpu.ops.fused_decode import fused_decode_attention
 
+        # One clamp shared by the scale scatters AND the kernel's k/v
+        # write: a drifted position (inactive engine slot) must hit the
+        # same row S-1 everywhere, or a quantized cache pairs fresh int8
+        # data with a stale scale (XLA drops OOB scatter updates; the
+        # kernel clamps — they must agree on the index).
+        positions = jnp.minimum(positions, layer_cache["k"].shape[2] - 1)
+        sidx = positions[:, None, :]
+
         kv_out = {}
         if quantized:
             kq, kscale = quantize_kv(kkT)

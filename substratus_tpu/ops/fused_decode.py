@@ -231,9 +231,16 @@ def fused_decode_attention(
     b, _, h, d = q.shape
     kh, s_len = cache_k.shape[1], cache_k.shape[2]
     quantized = new_ks is not None
+    # Halve-until-divides (same invariant as flash_attention._fit_block):
+    # keeps the block lane-aligned for the usual power-of-two cache
+    # lengths instead of walking down to odd sizes Mosaic lowers badly.
     bs = min(block_s, s_len)
     while s_len % bs:
-        bs -= 1
+        bs //= 2
+    # Defense in depth against position drift (see engine._decode_step):
+    # a position at/past the cache length would DMA-write outside the
+    # slot's rows, corrupting a neighbouring head's cache.
+    positions = jnp.clip(positions.astype(jnp.int32), 0, s_len - 1)
     qr, g, g8 = _pad_groups(q, kh)
 
     kernel = functools.partial(

@@ -772,8 +772,15 @@ class Engine:
             self.top_ps,
             self.key,
         )
-        self.positions = self.positions + 1
-        self.host_positions += 1
+        # Clamp at the last cache row: active slots are released at the
+        # window before reaching it (_emit's hit_window), so the clamp only
+        # catches INACTIVE slots, whose positions otherwise drift past the
+        # cache every step they sit idle — with the fused decode kernel
+        # that drift would become out-of-bounds HBM writes (XLA scatter
+        # silently dropped OOB updates; the Pallas DMA does not).
+        last = self.ec.max_seq_len - 1
+        self.positions = jnp.minimum(self.positions + 1, last)
+        self.host_positions = np.minimum(self.host_positions + 1, last)
         self.tokens = next_tokens
         host_tokens = np.asarray(next_tokens)
         for slot in np.flatnonzero(self.active):
@@ -908,6 +915,10 @@ class Engine:
                 if not self.active[slot]:
                     break
         self.tokens = jnp.asarray(next_tokens)
+        # Same inactive-slot drift clamp as _decode_step.
+        self.host_positions = np.minimum(
+            self.host_positions, self.ec.max_seq_len - 1
+        )
         self.positions = jnp.asarray(
             self.host_positions.astype(np.int32)
         )

@@ -74,7 +74,7 @@ def load_tokenizer(path: str | None) -> Tokenizer:
         UnsupportedGGUFTokenizer, resolve_gguf, tokenizer_from_gguf,
     )
 
-    gguf = resolve_gguf(path)
+    gguf = resolve_gguf(path, weights=False)
     unsupported: UnsupportedGGUFTokenizer | None = None
     if gguf is not None:
         try:

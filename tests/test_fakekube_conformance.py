@@ -210,6 +210,34 @@ def test_pod_spec_immutable_except_image(client):
         client.update(pod)
 
 
+def test_pod_tolerations_append_only(client):
+    """apiserver: ValidatePodUpdate permits only ADDING tolerations —
+    replacing or removing existing entries is rejected (ADVICE r4: a
+    controller relying on the fake's previous leniency would 422 on a
+    real cluster)."""
+    pod = _pod()
+    tol = {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+    pod["spec"]["tolerations"] = [tol]
+    pod = client.create(pod)
+    # appending is allowed
+    pod["spec"]["tolerations"] = [
+        tol, {"key": "extra", "operator": "Exists"},
+    ]
+    pod = client.update(pod)
+    # replacing the first entry is not
+    pod["spec"]["tolerations"] = [
+        {"key": "changed", "operator": "Exists"},
+        {"key": "extra", "operator": "Exists"},
+    ]
+    with pytest.raises(Invalid):
+        client.update(pod)
+    # neither is removal
+    pod = client.get("Pod", "default", "p")
+    pod["spec"]["tolerations"] = pod["spec"]["tolerations"][:1]
+    with pytest.raises(Invalid):
+        client.update(pod)
+
+
 def test_secret_string_data_write_only(client):
     """apiserver: Secret stringData is write-only — folded into data
     (base64, stringData wins on key conflict) and never stored/returned."""

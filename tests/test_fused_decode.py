@@ -165,3 +165,79 @@ def test_fused_decode_step_through_model():
         assert int(outs["fused"][step].argmax()) == int(
             outs["xla"][step].argmax()
         )
+
+
+def test_out_of_range_position_clamps_no_oob():
+    """ADVICE r4: inactive engine slots used to drift positions past the
+    cache length; the XLA scatter dropped OOB updates silently but the
+    fused kernel's DMA write would corrupt a neighbouring row. The
+    wrapper now clamps, so a pos >= S behaves exactly like pos = S-1 and
+    never touches another slot/head's rows."""
+    S, D, B, kh, h = 64, 32, 3, 2, 4
+    ks = jax.random.split(jax.random.key(7), 5)
+    q = _rand(ks[0], B, 1, h, D)
+    ck, cv = _rand(ks[1], B, kh, S, D), _rand(ks[2], B, kh, S, D)
+    nk, nv = _rand(ks[3], B, kh, 1, D), _rand(ks[4], B, kh, 1, D)
+    drifted = jnp.array([5, S + 17, 10 * S], jnp.int32)  # slots 1,2 drifted
+    clamped = jnp.minimum(drifted, S - 1)
+
+    ck2, cv2 = _scatter(ck, nk, clamped), _scatter(cv, nv, clamped)
+    ref = decode_attention(q, ck2, cv2, clamped, impl="xla")
+    attn, cko, cvo = fused_decode_attention(
+        q, nk, nv, ck, cv, drifted, block_s=32, interpret=True
+    )
+    np.testing.assert_allclose(attn, ref, atol=2e-6)
+    np.testing.assert_array_equal(cko, ck2)
+    np.testing.assert_array_equal(cvo, cv2)
+
+
+def test_block_fit_halves_for_non_pow2_cache():
+    """Non-power-of-two cache lengths must still pick a lane-friendly
+    block (halve-until-divides), not walk down by ones to a misaligned
+    odd size."""
+    S, D, B, kh, h = 96, 32, 1, 2, 2  # 96: 64 -> 32 divides
+    ks = jax.random.split(jax.random.key(9), 5)
+    q = _rand(ks[0], B, 1, h, D)
+    ck, cv = _rand(ks[1], B, kh, S, D), _rand(ks[2], B, kh, S, D)
+    nk, nv = _rand(ks[3], B, kh, 1, D), _rand(ks[4], B, kh, 1, D)
+    positions = jnp.array([41], jnp.int32)
+    ck2, cv2 = _scatter(ck, nk, positions), _scatter(cv, nv, positions)
+    ref = decode_attention(q, ck2, cv2, positions, impl="xla")
+    attn, cko, cvo = fused_decode_attention(
+        q, nk, nv, ck, cv, positions, block_s=64, interpret=True
+    )
+    np.testing.assert_allclose(attn, ref, atol=2e-6)
+    np.testing.assert_array_equal(cko, ck2)
+    np.testing.assert_array_equal(cvo, cv2)
+
+
+def test_drifted_position_quantized_scale_and_row_agree():
+    """Code-review r5: the position clamp must be shared by the scale
+    scatters (XLA, caller side) and the k/v row write (inside the
+    kernel). If they disagree, row S-1 of a quantized cache pairs fresh
+    int8 data with a stale scale. A drifted position must produce
+    exactly the state of a position clamped to S-1."""
+    B, h, kh, S, D = 2, 4, 2, 64, 16
+    ks = jax.random.split(jax.random.key(11), 4)
+    q = _rand(ks[0], B, 1, h, D)
+    kk = _rand(ks[1], B, 1, kh, D)
+    vv = _rand(ks[2], B, 1, kh, D)
+    hist_k, hks = quantize_kv(_rand(ks[3], B, kh, S, D))
+    cache = {
+        "k": hist_k,
+        "v": jnp.zeros((B, kh, S, D), jnp.int8),
+        "k_scale": hks[..., 0],
+        "v_scale": jnp.ones((B, kh, S), jnp.float32),
+    }
+    drifted = jnp.array([[5], [S + 33]], jnp.int32)
+    clamped = jnp.minimum(drifted, S - 1)
+
+    a_ref, kv_ref = update_cache_and_attend(
+        cache, q, kk, vv, clamped, impl="fused"
+    )
+    a_drift, kv_drift = update_cache_and_attend(
+        cache, q, kk, vv, drifted, impl="fused"
+    )
+    np.testing.assert_allclose(a_drift, a_ref, atol=2e-6)
+    for key in kv_ref:
+        np.testing.assert_array_equal(kv_drift[key], kv_ref[key])

@@ -597,3 +597,57 @@ def test_loader_converts_gguf_to_artifact(tmp_path):
     assert isinstance(tok, GGUFTokenizer)
     assert tok.eos_id == 2
     assert _VOCAB_TOKENS.index("▁hello") in tok.encode("hello")
+
+    # ...and the sidecar must NOT shadow the orbax weights on the
+    # checkpoint path: serve/train resolve GGUF first, so a converted
+    # artifact dir (whose only .gguf is the metadata-only tokenizer
+    # sidecar) has to resolve to "not a GGUF checkpoint" or every
+    # artifact the load job produces is unservable.
+    from substratus_tpu.load.gguf import (
+        gguf_has_tensors, resolve_gguf, resolve_gguf_or_exit,
+    )
+
+    assert not gguf_has_tensors(str(out / "tokenizer.gguf"))
+    assert resolve_gguf_or_exit(str(out)) is None
+    # the tokenizer resolver still sees the sidecar
+    assert resolve_gguf(str(out), weights=False) == str(
+        out / "tokenizer.gguf"
+    )
+    # naming the sidecar explicitly as a weight checkpoint fails loudly
+    with pytest.raises(SystemExit, match="metadata-only"):
+        resolve_gguf_or_exit(str(out / "tokenizer.gguf"))
+
+
+def test_serve_resolves_converted_artifact_weights(tmp_path):
+    """End-to-end ADVICE repro: serving a load-job-converted artifact dir
+    must restore the orbax weights (not crash trying to load the
+    tokenizer.gguf sidecar as a model)."""
+    from substratus_tpu.load.gguf import write_tokenizer_gguf
+    from substratus_tpu.load.hf import convert_llama_state_dict
+    from substratus_tpu.train.checkpoints import (
+        maybe_restore_orbax, save_artifact,
+    )
+
+    sd = _hf_weights(jax.random.key(0))
+    cfg = llama.LlamaConfig(
+        vocab_size=VOCAB, dim=DIM, n_layers=LAYERS, n_heads=HEADS,
+        n_kv_heads=KV_HEADS, hidden_dim=FFN, max_seq_len=128,
+    )
+    params = convert_llama_state_dict(sd, cfg)
+    out = tmp_path / "artifacts"
+    save_artifact(str(out), params, cfg)
+    assert write_tokenizer_gguf(str(out / "tokenizer.gguf"), _tok_meta())
+
+    # the serve entrypoint's resolution order: gguf -> orbax -> HF
+    from substratus_tpu.load.gguf import resolve_gguf_or_exit
+
+    assert resolve_gguf_or_exit(str(out)) is None
+    restored = maybe_restore_orbax(str(out))
+    assert restored is not None
+    rcfg, rparams = restored
+    assert rcfg.dim == DIM
+    out_logits = llama.forward(
+        rparams, jnp.array([[1, 5, 9]], jnp.int32), rcfg
+    )
+    logits = out_logits[0] if isinstance(out_logits, tuple) else out_logits
+    assert bool(jnp.all(jnp.isfinite(logits)))

@@ -27,6 +27,7 @@ REASON_DATASET_NOT_READY = "DatasetNotReady"
 REASON_DEPLOYMENT_READY = "DeploymentReady"
 REASON_DEPLOYMENT_NOT_READY = "DeploymentNotReady"
 REASON_SUSPENDED = "Suspended"
+REASON_INVALID_SPEC = "InvalidSpec"
 
 
 @dataclass

@@ -394,6 +394,8 @@ class ServerReconciler(BaseReconciler):
             mounts=mounts,
             restart_policy="Always",
         )
+        if pod["_slice"]["num_hosts"] > 1:
+            return self._reconcile_multihost(obj, pod)
         replicas = int((obj.get("spec") or {}).get("params", {}).get("replicas", 1))
         deployment: Obj = {
             "apiVersion": "apps/v1",
@@ -431,6 +433,68 @@ class ServerReconciler(BaseReconciler):
         reconcile_child(self.client, service)
         live = reconcile_child(self.client, deployment)
         ready = (live.get("status", {}).get("readyReplicas") or 0) > 0
+        obj.setdefault("status", {})["ready"] = ready
+        set_condition(
+            obj, C.CONDITION_SERVING, ready,
+            C.REASON_DEPLOYMENT_READY if ready else C.REASON_DEPLOYMENT_NOT_READY,
+        )
+        write_status(self.client, obj)
+        return Result()
+
+    def _reconcile_multihost(self, obj: Obj, pod: Dict) -> Result:
+        """Server over a multi-host TPU slice: a lockstep serving gang
+        (JobSet + headless rendezvous Service + a front Service routing
+        to worker 0) instead of a Deployment — the shape the
+        examples/llama2-70b v5e-16 Server needs and the single-pod
+        reference could not express (server_controller.go:114-205).
+        Ready when the gang's leader pod (completion index 0) reports
+        the Ready condition, which its HTTP readiness probe gates."""
+        from substratus_tpu.controller.workloads import (
+            serving_gang_name, serving_group_from_pod,
+            serving_leader_selector,
+        )
+
+        ns = obj["metadata"]["namespace"]
+        replicas = int(
+            (obj.get("spec") or {}).get("params", {}).get("replicas", 1)
+        )
+        if replicas > 1:
+            # Loud rejection beats silently serving 1/N of the asked
+            # capacity: gang replication (N JobSets behind one Service)
+            # is not implemented.
+            obj.setdefault("status", {})["ready"] = False
+            set_condition(
+                obj, C.CONDITION_SERVING, False, C.REASON_INVALID_SPEC,
+                f"params.replicas={replicas} is unsupported for "
+                "multi-host slices (one serving gang per Server)",
+            )
+            write_status(self.client, obj)
+            return Result()
+        for w in serving_group_from_pod(obj, pod):
+            reconcile_child(self.client, w)
+
+        want = serving_leader_selector(serving_gang_name(pod["_name"]))
+
+        def pod_ready(p: Dict) -> bool:
+            # Terminating pods don't count: during gang recreation a
+            # stale leader with a lingering Ready=True must not mask the
+            # replacement that is still starting.
+            if p.get("metadata", {}).get("deletionTimestamp"):
+                return False
+            conds = (p.get("status") or {}).get("conditions") or []
+            return any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in conds
+            )
+
+        ready = any(
+            pod_ready(p)
+            for p in self.client.list("Pod", ns)
+            if all(
+                (p.get("metadata", {}).get("labels") or {}).get(k) == v
+                for k, v in want.items()
+            )
+        )
         obj.setdefault("status", {})["ready"] = ready
         set_condition(
             obj, C.CONDITION_SERVING, ready,

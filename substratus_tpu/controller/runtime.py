@@ -78,6 +78,26 @@ class Manager:
             if ref.get("kind") in self.reconcilers:
                 self.enqueue(ref["kind"], ns, ref["name"])
 
+        # Gang-pod wakeup: a JobSet worker pod is two ownership hops from
+        # its CR (Pod -> Job -> JobSet -> CR), so readiness transitions
+        # would never requeue the CR through ownerReferences alone. The
+        # JobSet controller labels every pod with its gang; route the
+        # event to the JobSet's owners (the multi-host Server tracks its
+        # leader pod's Ready condition this way).
+        if kind == "Pod":
+            gang = (md.get("labels") or {}).get(
+                "jobset.sigs.k8s.io/jobset-name"
+            )
+            if gang:
+                try:
+                    js = self.client.get("JobSet", ns, gang)
+                except NotFound:
+                    js = None
+                if js is not None:
+                    for ref in js["metadata"].get("ownerReferences", []):
+                        if ref.get("kind") in self.reconcilers:
+                            self.enqueue(ref["kind"], ns, ref["name"])
+
         # Reference-index wakeup (reference manager.go:23-72): when a Model
         # or Dataset changes, requeue CRs whose spec points at it.
         if kind in ("Model", "Dataset"):

@@ -284,3 +284,70 @@ def workload_for_pod(obj: Obj, pod: Dict[str, Any], backoff_limit: int) -> List[
     if pod["_slice"]["num_hosts"] > 1:
         return jobset_from_pod(obj, pod, backoff_limit)
     return [job_from_pod(obj, pod, backoff_limit)]
+
+
+def serving_gang_name(front_name: str) -> str:
+    """JobSet/headless-Service name for a multi-host serving gang whose
+    client-facing front Service is `front_name`."""
+    return f"{front_name}-gang"
+
+
+# Leader pods of a serving gang (worker 0 owns HTTP; serve/multihost.py).
+# The JobSet controller stamps the jobset-name label on every pod and the
+# Job controller stamps the completion index, so this selector is exactly
+# "worker 0 of this gang".
+def serving_leader_selector(gang_name: str) -> Dict[str, str]:
+    return {
+        "jobset.sigs.k8s.io/jobset-name": gang_name,
+        "batch.kubernetes.io/job-completion-index": "0",
+    }
+
+
+def serving_group_from_pod(obj: Obj, pod: Dict[str, Any]) -> List[Obj]:
+    """Multi-host serving gang: [headless Service, JobSet, front Service].
+
+    A Server whose resources ask for a multi-host TPU slice (e.g. v5e
+    4x4 = 4 hosts x 4 chips) cannot be one Deployment pod — each host
+    runs one engine process and they jointly execute every step over the
+    global mesh (serve/multihost.py lockstep). The gang is a JobSet like
+    the trainer's (same TPU_WORKER_*/JAX_COORDINATOR env and headless
+    Service for rendezvous, jobset_from_pod above) with serving
+    restart semantics: containers restart in place (OnFailure) and the
+    whole gang is recreated on unrecoverable host failure. The FRONT
+    Service routes only to worker 0 — the lockstep leader owns HTTP;
+    followers serve no traffic. Replaces the reference's single-pod
+    Server shape (internal/controller/server_controller.go:114-205) for
+    slices the reference could never span.
+
+    Naming: the gang (JobSet + its headless rendezvous Service, which
+    must share the pods' subdomain) is `{name}-server-gang`; the FRONT
+    Service keeps the `{name}-server` name clients use on the
+    single-host path, so switching a Server between slice sizes never
+    changes its address."""
+    md = obj["metadata"]
+    front_name = pod["_name"]
+    pod = dict(pod)
+    pod["_name"] = serving_gang_name(front_name)
+    headless_svc, jobset = jobset_from_pod(obj, pod, backoff_limit=0)
+    tmpl = jobset["spec"]["replicatedJobs"][0]["template"]["spec"]
+    # In-place container restarts (a Job pod may not use Always); the
+    # JobSet failurePolicy still gang-recreates on pod/host loss.
+    tmpl["template"]["spec"]["restartPolicy"] = "OnFailure"
+    jobset["spec"]["failurePolicy"] = {"maxRestarts": 1000}
+
+    front_svc: Obj = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": front_name,
+            "namespace": md["namespace"],
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            "selector": serving_leader_selector(serving_gang_name(front_name)),
+            "ports": [
+                {"port": 8080, "targetPort": "http-serve", "name": "http"}
+            ],
+        },
+    }
+    return [headless_svc, jobset, front_svc]

@@ -56,14 +56,89 @@ def decode_attention(
 ) -> jnp.ndarray:
     """Single-token attention against the full cache. Slots at position
     > positions[b] are masked (freshly written current token included via
-    <=). Returns [B, 1, H, D] in q.dtype."""
+    <=). Returns [B, 1, H, D] in q.dtype.
+
+    impl="pallas" routes through a custom_partitioning rule (the kernel
+    is local per (batch, kv-head) shard), so it survives GSPMD-sharded
+    serving instead of requiring the xla fallback."""
     if impl == "pallas":
-        return _pallas(
-            q, k, v, positions, k_scale, v_scale,
-            block_s=block_s, interpret=interpret,
-        )
+        quantized = k_scale is not None
+        args = (q, k, v, positions)
+        if quantized:
+            args = args + (k_scale, v_scale)
+        return _pallas_sp(quantized, block_s, interpret)(*args)
     assert impl == "xla", impl
     return _xla(q, k, v, positions, k_scale, v_scale)
+
+
+_PALLAS_SP_CACHE: dict = {}
+
+
+def _pallas_sp(quantized: bool, block_s: int, interpret):
+    """custom_partitioning wrapper for the unfused decode kernel: same
+    per-(batch, kv-head) locality argument as fused_decode._fused_sp;
+    the cache's committed sharding names the batch/head mesh axes and
+    every operand/result spec follows from it."""
+    key = (quantized, block_s, interpret)
+    if key in _PALLAS_SP_CACHE:
+        return _PALLAS_SP_CACHE[key]
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    def impl_fn(*args):
+        if quantized:
+            q, k, v, pos, ks, vs = args
+        else:
+            (q, k, v, pos), ks, vs = args, None, None
+        return _pallas(
+            q, k, v, pos, ks, vs, block_s=block_s, interpret=interpret
+        )
+
+    f = custom_partitioning(impl_fn)
+
+    def specs(arg_shapes):
+        from jax.sharding import PartitionSpec as P
+
+        ck = arg_shapes[1]  # cache k [B, KH, S, D]
+        spec = getattr(ck.sharding, "spec", None) or ()
+        spec = tuple(spec) + (None,) * (4 - len(spec))
+        b, h = spec[0], spec[1]
+        args = [
+            P(b, None, h, None),  # q
+            P(b, h, None, None),  # k
+            P(b, h, None, None),  # v
+            P(b),                 # positions
+        ]
+        if quantized:
+            args += [P(b, h, None), P(b, h, None)]  # k_scale, v_scale
+        return args, P(b, None, h, None)
+
+    def infer(mesh, arg_shapes, result_shape):
+        from jax.sharding import NamedSharding
+
+        _, out = specs(arg_shapes)
+        return NamedSharding(mesh, out)
+
+    def partition(mesh, arg_shapes, result_shape):
+        from jax.sharding import NamedSharding
+
+        args, out = specs(arg_shapes)
+        return (
+            mesh,
+            impl_fn,
+            NamedSharding(mesh, out),
+            tuple(NamedSharding(mesh, s) for s in args),
+        )
+
+    rule = (
+        "b u h d, b k s d, b k s d, b, b k s2, b k s3 -> b u h d"
+        if quantized
+        else "b u h d, b k s d, b k s d, b -> b u h d"
+    )
+    f.def_partition(
+        partition, infer_sharding_from_operands=infer, sharding_rule=rule
+    )
+    _PALLAS_SP_CACHE[key] = f
+    return f
 
 
 def _xla(q, k, v, positions, k_scale, v_scale):

@@ -229,12 +229,174 @@ def _matmul(x2: jnp.ndarray, packed: jnp.ndarray, scale: jnp.ndarray,
     )(x2, packed, scale)
 
 
+def _q4_xla_2d(x2: jnp.ndarray, p2: jnp.ndarray, s2: jnp.ndarray,
+               block: int) -> jnp.ndarray:
+    """XLA lowering of x2 [M, C] @ packed [C/2, N]: one fused einsum per
+    nibble plane (the block-fold pack maps plane rows to strided x
+    slices). Elementwise producers + dots only — CPU-correct and
+    SPMD-shardable. Shared by the generic fallback AND by shards whose
+    local shapes don't fit the kernel's tiling."""
+    M, C = x2.shape
+    N = p2.shape[1]
+    half = block // 2
+    g = C // block
+    lo, hi = _nibbles(p2)  # [C/2, N] int8
+    xg = x2.reshape(M, g, block)
+    sa = s2.reshape(g, 1, N)
+    dtype = x2.dtype
+    lo3 = (lo.reshape(g, half, N).astype(jnp.float32) * sa).astype(dtype)
+    hi3 = (hi.reshape(g, half, N).astype(jnp.float32) * sa).astype(dtype)
+    y = jnp.einsum(
+        "mgh,ghn->mn", xg[:, :, :half], lo3,
+        preferred_element_type=jnp.float32,
+    ) + jnp.einsum(
+        "mgh,ghn->mn", xg[:, :, half:], hi3,
+        preferred_element_type=jnp.float32,
+    )
+    return y
+
+
+# Count of Pallas-kernel TRACES (compile-time): tests assert the sharded
+# path actually lowered the kernel instead of silently falling back.
+_KERNEL_TRACES = 0
+
+
+def kernel_trace_count() -> int:
+    return _KERNEL_TRACES
+
+
+def _local_q4_matmul(x2, p2, s2, block: int) -> jnp.ndarray:
+    """Per-shard (or unsharded) lowering: the Pallas kernel when the
+    local shapes fit its tiling, else the XLA nibble-plane formula.
+    Output dtype = x2.dtype either way."""
+    global _KERNEL_TRACES
+    M, C = x2.shape
+    N = p2.shape[1]
+    if M >= 8 and N % 128 == 0 and C % (2 * block) == 0:
+        _KERNEL_TRACES += 1
+        interpret = jax.default_backend() != "tpu"
+        return _matmul(x2, p2, s2, block, interpret=interpret)
+    return _q4_xla_2d(x2, p2, s2, block).astype(x2.dtype)
+
+
+def _spec_tuple(shape_struct, rank: int):
+    s = getattr(shape_struct, "sharding", None)
+    if s is None or not hasattr(s, "spec"):
+        return (None,) * rank
+    spec = tuple(s.spec) + (None,) * (rank - len(s.spec))
+    return spec[:rank]
+
+
+def _q4_axes(mesh, arg_shapes, block: int):
+    """(m_axis, c_axis, n_axis) mesh axes of a sharded q4 matmul. The
+    PACKED weight's committed sharding is authoritative: its axis 0
+    names the contracting (row-parallel wo/down) axis, its axis 1 the
+    output-feature (column-parallel wq/wk/wv/gate/up/lm_head) axis; the
+    activation keeps whatever batch-dim sharding GSPMD propagated.
+
+    Row-parallel is only kept when every shard's contracting slice
+    covers whole scale groups (local C a multiple of `block`, scale rows
+    divisible) — otherwise the weight replicates (degenerate tiny-config
+    case; every real config has C/block >> tensor)."""
+    xs, ps, ss = arg_shapes
+    c_axis, n_axis = _spec_tuple(ps, 2)
+    m_axis = _spec_tuple(xs, 2)[0]
+    if m_axis is not None and m_axis in (c_axis, n_axis):
+        m_axis = None
+    if c_axis is not None:
+        tp = int(np_prod(mesh.shape[a] for a in _axis_names(c_axis)))
+        C = xs.shape[1]
+        groups = ss.shape[0]
+        if groups % tp or (C // tp) % block:
+            c_axis = None
+    return m_axis, c_axis, n_axis
+
+
+def _axis_names(axis) -> tuple:
+    return axis if isinstance(axis, tuple) else (axis,)
+
+
+def np_prod(it) -> int:
+    p = 1
+    for v in it:
+        p *= int(v)
+    return p
+
+
+def _make_q4_mm_infer(block: int):
+    def infer(mesh, arg_shapes, result_shape):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m_axis, _, n_axis = _q4_axes(mesh, arg_shapes, block)
+        return NamedSharding(mesh, P(m_axis, n_axis))
+
+    return infer
+
+
+def _make_q4_mm_sp(block: int):
+    """custom_partitioning wrapper giving the Pallas kernel the SPMD
+    partitioning rule pallas_call lacks: GSPMD/Shardy keeps the kernel
+    per-shard (column-parallel runs it locally; row-parallel adds the
+    psum), so sharded serving no longer pins the XLA fallback
+    (round-4 gap: serve/main.py used to force xla under any mesh).
+    One wrapper per group size — custom_partitioning's partition
+    callback has no static-arg channel, so `block` rides the closure."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    @custom_partitioning
+    def q4_mm(x2, p2, s2):
+        return _local_q4_matmul(x2, p2, s2, block)
+
+    def partition(mesh, arg_shapes, result_shape):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m_axis, c_axis, n_axis = _q4_axes(mesh, arg_shapes, block)
+
+        def lower(x2, p2, s2):
+            y = _local_q4_matmul(x2, p2, s2, block)
+            if c_axis is not None:
+                # Row-parallel: every shard holds a partial sum over
+                # its contracting slice.
+                y = lax.psum(y, c_axis)
+            return y
+
+        result_sharding = NamedSharding(mesh, P(m_axis, n_axis))
+        arg_shardings = (
+            NamedSharding(mesh, P(m_axis, c_axis)),
+            NamedSharding(mesh, P(c_axis, n_axis)),
+            NamedSharding(mesh, P(c_axis, n_axis)),
+        )
+        return mesh, lower, result_sharding, arg_shardings
+
+    q4_mm.def_partition(
+        partition,
+        infer_sharding_from_operands=_make_q4_mm_infer(block),
+        # Factor naming for Shardy propagation: n is shared by the packed
+        # weight, the scale, and the output (column-parallel flows
+        # through); the contracting-family dims (k, j, g — different
+        # sizes) stay independent factors, and the partition callback
+        # forces their consistency from the packed weight's spec.
+        sharding_rule="m k, j n, g n -> m n",
+    )
+    return q4_mm
+
+
+_Q4_MM_SP: dict = {}
+
+
+def _q4_mm_sp(x2, p2, s2, block: int):
+    if block not in _Q4_MM_SP:
+        _Q4_MM_SP[block] = _make_q4_mm_sp(block)
+    return _Q4_MM_SP[block](x2, p2, s2)
+
+
 _FORCE_IMPL: Optional[str] = os.environ.get("SUBSTRATUS_Q4_IMPL") or None
 
 
 def set_q4_impl(impl: Optional[str]) -> None:
     """Force the q4einsum lowering: "pallas", "xla", or None for auto
-    (pallas on an un-meshed TPU backend, xla elsewhere)."""
+    (pallas on a TPU backend — sharded or not, via the
+    custom_partitioning rule — xla elsewhere)."""
     global _FORCE_IMPL
     assert impl in (None, "pallas", "xla"), impl
     _FORCE_IMPL = impl
@@ -244,24 +406,9 @@ def _use_pallas() -> bool:
     if _FORCE_IMPL is not None:
         return _FORCE_IMPL == "pallas"
     try:
-        if jax.default_backend() != "tpu":
-            return False
-        # Any multi-device process may be GSPMD-sharding the computation
-        # (plain jit + NamedSharding params never enters an abstract-mesh
-        # context, so sharding is invisible at trace time) — and
-        # pallas_call has no SPMD partitioning rule. Only the single-chip
-        # path auto-selects the kernel; sharded serving sets
-        # set_q4_impl("xla") explicitly (serve/main.py) and single-chip
-        # pallas can be forced with set_q4_impl("pallas").
-        if jax.device_count() > 1:
-            return False
+        return jax.default_backend() == "tpu"
     except Exception:  # noqa: BLE001 — backend init failure means no TPU
         return False
-    # Under an ambient mesh (use_mesh / shard_map tracing) same story.
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is not None and not mesh.empty and mesh.size > 1:
-        return False
-    return True
 
 
 def q4einsum(eq: str, x: jnp.ndarray, w: Q4Tensor,
@@ -305,26 +452,14 @@ def q4einsum(eq: str, x: jnp.ndarray, w: Q4Tensor,
     s2 = w.scale.reshape(-1, N)
     out_shape = batch_shape + w.packed.shape[nc:]
 
-    if _use_pallas() and M >= 8 and N % 128 == 0 and C % (2 * w.block) == 0:
-        y = _matmul(x2, p2, s2, w.block)
+    if _use_pallas():
+        # Kernel path, sharded or not: the custom_partitioning rule keeps
+        # the Pallas kernel per-shard under GSPMD (shards whose local
+        # shapes miss the tiling fall back to the XLA formula inside
+        # _local_q4_matmul — loudly countable via kernel_trace_count).
+        y = _q4_mm_sp(x2, p2, s2, w.block)
     else:
-        # XLA path: one fused einsum per nibble plane (the block-fold pack
-        # maps plane rows to strided x slices). Elementwise producers +
-        # dots only — CPU-correct and SPMD-shardable.
-        half = w.block // 2
-        g = C // w.block
-        lo, hi = _nibbles(p2)  # [C/2, N] int8
-        xg = x2.reshape(M, g, w.block)
-        sa = s2.reshape(g, 1, N)
-        lo3 = (lo.reshape(g, half, N).astype(jnp.float32) * sa).astype(dtype)
-        hi3 = (hi.reshape(g, half, N).astype(jnp.float32) * sa).astype(dtype)
-        y = jnp.einsum(
-            "mgh,ghn->mn", xg[:, :, :half], lo3,
-            preferred_element_type=jnp.float32,
-        ) + jnp.einsum(
-            "mgh,ghn->mn", xg[:, :, half:], hi3,
-            preferred_element_type=jnp.float32,
-        )
+        y = _q4_xla_2d(x2, p2, s2, w.block)
     return y.reshape(out_shape).astype(dtype)
 
 

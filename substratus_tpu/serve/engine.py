@@ -88,6 +88,13 @@ class Request:
     # sequence match) sets this; the scheduler frees the slot at the next
     # emit instead of decoding to max_tokens.
     cancelled: bool = False
+    # Multi-host lockstep bookkeeping (serve/multihost.py): the leader
+    # latches `cancelled` into `cancel_latched` at an iteration boundary
+    # and broadcasts the latch, so every process observes the
+    # cancellation at the same step; `sync_id` names the request across
+    # processes.
+    cancel_latched: bool = False
+    sync_id: Optional[int] = None
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -99,12 +106,14 @@ def _bucket(n: int, lo: int = 16) -> int:
 
 def _pad_to_bucket(tokens, cap: int):
     """Right-pad a token list to its power-of-two bucket (capped): the one
-    padding rule both the single-shot and chunked prefill paths share."""
+    padding rule both the single-shot and chunked prefill paths share.
+    Returns host numpy — jit converts, and under a multi-host mesh a
+    numpy input is the one form every process can feed identically."""
     true_len = len(tokens)
     bucket = min(_bucket(true_len), cap)
     padded = np.zeros((1, bucket), np.int32)
     padded[0, :true_len] = tokens
-    return jnp.asarray(padded), true_len
+    return padded, true_len
 
 
 class Engine:
@@ -116,6 +125,7 @@ class Engine:
         mesh=None,
         model=llama,
         draft: Optional[tuple] = None,  # (draft_cfg, draft_params)
+        sync=None,  # serve.multihost.StepSync for multi-host lockstep
     ):
         """model: the model-family module (models.llama, models.opt, ...)
         implementing forward/init_cache/param_logical_axes/cache_logical_axes.
@@ -213,7 +223,7 @@ class Engine:
                     SERVE_RULES,
                 )
             self.cache = pool
-            self.block_table = jnp.zeros((B, self.max_pages), jnp.int32)
+            self.block_table = np.zeros((B, self.max_pages), np.int32)
             self.alloc = PageAllocator(self.n_pages, first_page=1)
             self.prefix = (
                 PrefixRegistry(self.alloc) if ec.prefix_cache else None
@@ -228,11 +238,17 @@ class Engine:
             )
         else:
             self.cache = model.init_cache(cfg, B, S, dtype=cache_dtype)
-        self.tokens = jnp.zeros((B,), jnp.int32)
-        self.positions = jnp.zeros((B,), jnp.int32)
-        self.temps = jnp.zeros((B,), jnp.float32)
-        self.top_ps = jnp.ones((B,), jnp.float32)
-        self.key = jax.random.key(0)
+        # Small per-step state lives as HOST numpy and is fed into the
+        # jitted functions each call (jit treats numpy inputs as
+        # replicated — in multi-host lockstep serving every process feeds
+        # the identical value, which is exactly the contract). The RNG key
+        # is carried as raw key data for the same reason; the jitted fns
+        # wrap/unwrap it at the boundary.
+        self.tokens = np.zeros((B,), np.int32)
+        self.positions = np.zeros((B,), np.int32)
+        self.temps = np.zeros((B,), np.float32)
+        self.top_ps = np.ones((B,), np.float32)
+        self.key = np.asarray(jax.random.key_data(jax.random.key(0)))
 
         # Host-side slot bookkeeping (scheduler thread only). host_positions
         # mirrors the device positions array so per-token checks never force
@@ -309,15 +325,23 @@ class Engine:
         self.error: Optional[BaseException] = None
         self._admitting: Optional[Request] = None
 
+        # Multi-host lockstep (serve/multihost.py). The sync'd request
+        # list replaces the thread-safe queue as the scheduler's source:
+        # requests enter it only through _sync_iterate, identically on
+        # every process.
+        self.sync = sync if (sync is not None and sync.num_processes > 1) else None
+        self._sync_seq = 0
+        self._sync_reqs: Dict[int, Request] = {}
+        self._synced: List[Request] = []
+
         self._decode_fn = self._build_decode()
+        self._sample1_fn = self._build_first_sample()
         self._chunk_fn = partial(self._chunk_prefill_jit, self.model, self.cfg)
         if self.spec_draft:
             self._draft_chunk_fn = partial(
                 self._chunk_prefill_jit, self.model, self.draft_cfg
             )
-            self._propose_fn = partial(
-                self._propose_jit, self.model, self.draft_cfg, self.ec.spec_k
-            )
+            self._propose_fn = self._build_propose()
         if self.spec:
             self._verify_fn = self._build_verify()
         if not self.paged:
@@ -360,36 +384,54 @@ class Engine:
         )
         return logits[0, true_len - 1], slot_cache
 
-    @staticmethod
-    @partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4,))
-    def _propose_jit(model, cfg, k, params, cache, block_table, tokens,
-                     positions):
-        """Draft k greedy tokens for the whole batch: k cheap decode steps
-        through the draft's paged pool. Returns (proposals [B, k], cache)."""
+    def _build_propose(self):
+        model, cfg, k = self.model, self.draft_cfg, self.ec.spec_k
 
-        def step(carry, _):
-            cache, tok, pos = carry
-            logits, cache = model.forward(
-                params, tok[:, None], cfg, positions=pos[:, None],
-                cache=cache, block_table=block_table,
+        @partial(jax.jit, donate_argnums=(1,))
+        def propose(params, cache, block_table, tokens, positions):
+            """Draft k greedy tokens for the whole batch: k cheap decode
+            steps through the draft's paged pool. Returns (proposals
+            [B, k] replicated for the host read, cache)."""
+
+            def step(carry, _):
+                cache, tok, pos = carry
+                logits, cache = model.forward(
+                    params, tok[:, None], cfg, positions=pos[:, None],
+                    cache=cache, block_table=block_table,
+                )
+                nxt = logits[:, 0].argmax(-1).astype(jnp.int32)
+                return (cache, nxt, pos + 1), nxt
+
+            (cache, _, _), props = jax.lax.scan(
+                step, (cache, tokens, positions), None, length=k
             )
-            nxt = logits[:, 0].argmax(-1).astype(jnp.int32)
-            return (cache, nxt, pos + 1), nxt
+            return self._replicated(jnp.swapaxes(props, 0, 1)), cache
 
-        (cache, _, _), props = jax.lax.scan(
-            step, (cache, tokens, positions), None, length=k
-        )
-        return jnp.swapaxes(props, 0, 1), cache  # [B, k]
+        return propose
+
+    def _replicated(self, *xs):
+        """Pin small outputs that the scheduler reads back to host to a
+        fully-replicated layout. Under a (multi-host) mesh the compiler is
+        otherwise free to leave them sharded, which would make
+        np.asarray() on them non-addressable on some process; without a
+        mesh this is a no-op constraint."""
+        if self.mesh is None:
+            return xs if len(xs) > 1 else xs[0]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        out = tuple(jax.lax.with_sharding_constraint(x, rep) for x in xs)
+        return out if len(out) > 1 else out[0]
 
     def _build_verify(self):
         cfg, ec, model = self.cfg, self.ec, self.model
 
         @partial(jax.jit, donate_argnums=(1,))
         def verify(params, cache, block_table, block_tokens, positions0,
-                   temps, top_ps, key):
+                   temps, top_ps, key_data):
             """ONE target forward over [last, d1..dk] per slot ([B, k+1]).
             Returns (greedy choices [B, k+1], position-0 samples [B] for
-            sampling slots, cache, key)."""
+            sampling slots, cache, key data)."""
             s = block_tokens.shape[1]
             positions = (
                 positions0[:, None]
@@ -400,11 +442,14 @@ class Engine:
                 block_table=block_table,
             )
             choices = logits.argmax(-1).astype(jnp.int32)
-            key, subkey = jax.random.split(key)
+            key, subkey = jax.random.split(jax.random.wrap_key_data(key_data))
             sampled = sample(
                 logits[:, 0], subkey, temps, top_k=ec.top_k, top_p=top_ps
             )
-            return choices, sampled, cache, key
+            choices, sampled, kd = self._replicated(
+                choices, sampled, jax.random.key_data(key)
+            )
+            return choices, sampled, cache, kd
 
         return verify
 
@@ -452,7 +497,7 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, block_table, tokens, positions, temps,
-                   top_ps, key):
+                   top_ps, key_data):
             logits, cache = model.forward(
                 params,
                 tokens[:, None],
@@ -461,17 +506,43 @@ class Engine:
                 cache=cache,
                 **({"block_table": block_table} if paged else {}),
             )
-            key, subkey = jax.random.split(key)
+            key, subkey = jax.random.split(jax.random.wrap_key_data(key_data))
             next_tokens = sample(
                 logits[:, 0], subkey, temps, top_k=ec.top_k, top_p=top_ps
             )
-            return next_tokens, cache, key
+            next_tokens, kd = self._replicated(
+                next_tokens, jax.random.key_data(key)
+            )
+            return next_tokens, cache, kd
 
         return decode
+
+    def _build_first_sample(self):
+        ec = self.ec
+
+        @jax.jit
+        def first_sample(last_logits, key_data, temp, top_p):
+            """Sample the first generated token from prefill logits;
+            returns (token [1], new key data), both replicated for the
+            scheduler's host read."""
+            key, subkey = jax.random.split(
+                jax.random.wrap_key_data(key_data)
+            )
+            first = sample(
+                last_logits[None, :], subkey, temp, top_k=ec.top_k,
+                top_p=top_p,
+            )
+            return self._replicated(first, jax.random.key_data(key))
+
+        return first_sample
 
     # --- scheduler --------------------------------------------------------
 
     def submit(self, req: Request) -> Request:
+        if self.sync is not None and not self.sync.leader:
+            raise RuntimeError(
+                "follower engine: requests arrive via the leader broadcast"
+            )
         if self.error is not None:
             req.finish_reason = "error"
             req.out.put(None)  # engine is dead; never strand the caller
@@ -500,13 +571,82 @@ class Engine:
         """Resumed/held-back requests board before the public queue."""
         if self._resume:
             return self._resume.pop(0)
+        if self.sync is not None:
+            # Lockstep mode: the queue is drained only at _sync_iterate;
+            # admission pulls from the broadcast-ordered list so every
+            # process admits the same requests at the same iteration.
+            return self._synced.pop(0) if self._synced else None
         try:
             return self.queue.get_nowait()
         except queue.Empty:
             return None
 
     def _has_pending(self) -> bool:
+        if self.sync is not None:
+            return bool(self._resume) or bool(self._synced)
         return bool(self._resume) or not self.queue.empty()
+
+    def _is_cancelled(self, req: Request) -> bool:
+        """Lockstep mode reads the broadcast latch (identical on every
+        process at a given iteration); single-process reads the live flag."""
+        return req.cancel_latched if self.sync is not None else req.cancelled
+
+    def _sync_iterate(self) -> bool:
+        """Top-of-iteration synchronization point. Returns False when the
+        engine should stop. In lockstep mode the leader drains its queue
+        and broadcasts this iteration's events; every process then applies
+        them identically."""
+        if self.sync is None:
+            return not self._stop.is_set()
+        from substratus_tpu.serve.multihost import (
+            NullSink, decode_events, encode_events,
+        )
+
+        if self.sync.leader:
+            new: List[Request] = []
+            while True:
+                try:
+                    new.append(self.queue.get_nowait())
+                except queue.Empty:
+                    break
+            for r in new:
+                self._sync_seq += 1
+                r.sync_id = self._sync_seq
+            cancels = [
+                i for i, r in self._sync_reqs.items()
+                if r.cancelled and not r.cancel_latched
+            ]
+            stop = self._stop.is_set()
+            self.sync.broadcast(encode_events(new, cancels, stop))
+            msg = {"cancels": cancels, "stop": stop}
+        else:
+            msg = decode_events(self.sync.broadcast(None))
+            new = []
+            for d in msg["reqs"]:
+                self._sync_seq += 1  # mirrors the leader's numbering
+                new.append(
+                    Request(
+                        prompt_tokens=d["p"],
+                        max_tokens=d["m"],
+                        temperature=d["t"],
+                        top_p=d["tp"],
+                        eos_token_id=d["e"],
+                        id=d["id"],
+                        out=NullSink(),
+                        sync_id=d["sid"],
+                    )
+                )
+        for r in new:
+            self._sync_reqs[r.sync_id] = r
+            self._synced.append(r)
+        for cid in msg["cancels"]:
+            r = self._sync_reqs.get(cid)
+            if r is not None:
+                r.cancel_latched = True
+        if msg["stop"]:
+            self._stop.set()
+            return False
+        return True
 
     def _admit(self):
         """Fill free slots from the request queue (prefill + insert).
@@ -612,8 +752,8 @@ class Engine:
         pages = self.slot_pages.pages[slot]
         row = np.zeros((self.max_pages,), np.int32)
         row[: len(pages)] = pages
-        self.block_table = self.block_table.at[slot].set(jnp.asarray(row))
-        bt_row = self.block_table[slot : slot + 1]
+        self.block_table[slot] = row
+        bt_row = self.block_table[slot : slot + 1].copy()
 
         last_logits, self.cache = self._run_chunks(
             self._chunk_fn, self.params, self.cache, prompt, reuse, bt_row
@@ -657,14 +797,13 @@ class Engine:
     def _finalize_admit(self, req: Request, slot: int, last_logits,
                         true_len: int) -> None:
         # Sample the first generated token from the prefill logits.
-        self.key, subkey = jax.random.split(self.key)
-        first = sample(
-            last_logits[None, :],
-            subkey,
-            jnp.array([req.temperature], jnp.float32),
-            top_k=self.ec.top_k,
-            top_p=jnp.array([req.top_p], jnp.float32),
+        first, key_out = self._sample1_fn(
+            last_logits,
+            self.key,
+            np.array([req.temperature], np.float32),
+            np.array([req.top_p], np.float32),
         )
+        self.key = np.asarray(key_out)
         first_id = int(first[0])
 
         self.slot_req[slot] = req
@@ -674,10 +813,10 @@ class Engine:
         self.slot_tokens[slot] = []
         self._admit_counter += 1
         self.slot_admit_seq[slot] = self._admit_counter
-        self.tokens = self.tokens.at[slot].set(first_id)
-        self.positions = self.positions.at[slot].set(true_len)
-        self.temps = self.temps.at[slot].set(req.temperature)
-        self.top_ps = self.top_ps.at[slot].set(req.top_p)
+        self.tokens[slot] = first_id
+        self.positions[slot] = true_len
+        self.temps[slot] = req.temperature
+        self.top_ps[slot] = req.top_p
         self._emit(slot, first_id)
 
     # --- paged pool management -------------------------------------------
@@ -745,13 +884,15 @@ class Engine:
                     req = self.slot_req[slot]
                     req.finish_reason = "length"
                     req.out.put(None)
+                    if req.sync_id is not None:
+                        self._sync_reqs.pop(req.sync_id, None)
                     self._release_slot(slot)
                     self.stats["truncated_by_pool"] += 1
                     return
                 self._preempt(victim)
                 got = self._try_alloc(1)
             self.slot_pages.append(slot, got[0])
-            self.block_table = self.block_table.at[slot, pn].set(got[0])
+            self.block_table[slot, pn] = got[0]
 
     def _decode_step(self) -> None:
         """One plain decode iteration: every active slot advances a token."""
@@ -762,7 +903,7 @@ class Engine:
                 self._ensure_capacity(int(slot))
             if not self.active.any():
                 return
-        next_tokens, self.cache, self.key = self._decode_fn(
+        next_tokens, self.cache, key_out = self._decode_fn(
             self.params,
             self.cache,
             self.block_table if self.paged else None,
@@ -772,6 +913,7 @@ class Engine:
             self.top_ps,
             self.key,
         )
+        self.key = np.asarray(key_out)
         # Clamp at the last cache row: active slots are released at the
         # window before reaching it (_emit's hit_window), so the clamp only
         # catches INACTIVE slots, whose positions otherwise drift past the
@@ -779,10 +921,10 @@ class Engine:
         # that drift would become out-of-bounds HBM writes (XLA scatter
         # silently dropped OOB updates; the Pallas DMA does not).
         last = self.ec.max_seq_len - 1
-        self.positions = jnp.minimum(self.positions + 1, last)
+        self.positions = np.minimum(self.positions + 1, last)
         self.host_positions = np.minimum(self.host_positions + 1, last)
-        self.tokens = next_tokens
         host_tokens = np.asarray(next_tokens)
+        self.tokens = host_tokens.copy()
         for slot in np.flatnonzero(self.active):
             self._emit(int(slot), int(host_tokens[slot]))
 
@@ -869,19 +1011,20 @@ class Engine:
                 self.draft_params, self.draft_cache, self.block_table,
                 self.tokens, self.positions,
             )
+            props = np.asarray(proposals)
         else:
-            proposals = jnp.asarray(lookup_props)
-        block = jnp.concatenate([self.tokens[:, None], proposals], axis=1)
-        choices, sampled, self.cache, self.key = self._verify_fn(
+            props = lookup_props
+        block = np.concatenate([self.tokens[:, None], props], axis=1)
+        choices, sampled, self.cache, key_out = self._verify_fn(
             self.params, self.cache, self.block_table, block,
             self.positions, self.temps, self.top_ps, self.key,
         )
+        self.key = np.asarray(key_out)
         self.stats["verify_passes"] += 1
 
-        props = np.asarray(proposals)
         chs = np.asarray(choices)
         smp = np.asarray(sampled)
-        next_tokens = np.asarray(self.tokens).copy()
+        next_tokens = self.tokens.copy()
         for slot in np.flatnonzero(self.active):
             slot = int(slot)
             req = self.slot_req[slot]
@@ -914,14 +1057,12 @@ class Engine:
                 self._emit(slot, tok)
                 if not self.active[slot]:
                     break
-        self.tokens = jnp.asarray(next_tokens)
+        self.tokens = next_tokens
         # Same inactive-slot drift clamp as _decode_step.
         self.host_positions = np.minimum(
             self.host_positions, self.ec.max_seq_len - 1
         )
-        self.positions = jnp.asarray(
-            self.host_positions.astype(np.int32)
-        )
+        self.positions = self.host_positions.astype(np.int32)
 
     def _release_slot(self, slot: int) -> None:
         self.active[slot] = False
@@ -932,7 +1073,7 @@ class Engine:
             # Point the idle slot back at the trash page; its decode writes
             # keep happening (static shapes) and must never land in a page
             # the allocator may hand to someone else.
-            self.block_table = self.block_table.at[slot].set(0)
+            self.block_table[slot] = 0
 
     def _chunked_prefill(self, prompt, slot: int):
         """Prefill a prompt longer than one bucket: run bucket-sized chunks
@@ -952,24 +1093,29 @@ class Engine:
         hit_eos = token_id == eos
         hit_budget = self.slot_generated[slot] >= req.max_tokens
         hit_window = int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
-        if not hit_eos and not req.cancelled:
+        cancelled = self._is_cancelled(req)
+        if not hit_eos and not cancelled:
             req.out.put(token_id)
             self.slot_tokens[slot].append(token_id)
-        if hit_eos or hit_budget or hit_window or req.cancelled:
+        if hit_eos or hit_budget or hit_window or cancelled:
             # eos/cancel are natural stops; running out of budget or context
             # is a truncation ("length") clients may want to continue from.
             req.finish_reason = (
-                "stop" if (hit_eos or req.cancelled) else "length"
+                "stop" if (hit_eos or cancelled) else "length"
             )
             req.out.put(None)
+            if req.sync_id is not None:
+                self._sync_reqs.pop(req.sync_id, None)
             self._release_slot(slot)
 
     def _loop(self):
         try:
-            while not self._stop.is_set():
+            while self._sync_iterate():
                 self._admit()
                 if not self.active.any():
-                    time.sleep(0.002)
+                    # Lockstep mode pays a collective per iteration, so
+                    # idle gangs tick slower (<=20ms first-token cost).
+                    time.sleep(0.02 if self.sync is not None else 0.002)
                     continue
                 if self.spec:
                     self._spec_step()
@@ -977,6 +1123,17 @@ class Engine:
                     self._decode_step()
         except BaseException as e:  # propagate to waiting callers
             self.error = e
+            if self.sync is not None and self.sync.leader:
+                # Best-effort stop broadcast: without it every follower
+                # blocks forever inside the next header collective and
+                # the gang wedges with no pod failure for the JobSet
+                # failurePolicy to act on.
+                from substratus_tpu.serve.multihost import encode_events
+
+                try:
+                    self.sync.broadcast(encode_events([], [], True))
+                except Exception:
+                    pass  # the collective itself may be what broke
 
             def kill(req: Request) -> None:
                 # "error", not the "stop" default: consumers must be able

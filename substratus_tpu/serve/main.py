@@ -122,7 +122,7 @@ def main(argv=None) -> int:
         (
             "model", "config", "quantize", "max_batch", "max_seq_len",
             "max_prefill_len", "kv_cache_dtype", "kv_layout", "attn_impl",
-            "chunk_attn_impl", "decode_attn_impl", "tensor",
+            "chunk_attn_impl", "decode_attn_impl", "q4_impl", "tensor",
             "replicas", "draft_model", "spec_k",
         ),
         "serve.main",
@@ -232,25 +232,19 @@ def main(argv=None) -> int:
         if max_batch % (n_dev // tp):
             ec.max_batch = ((max_batch // (n_dev // tp)) + 1) * (n_dev // tp)
         print(f"serving mesh: data={n_dev // tp} tensor={tp}", flush=True)
-        if quantize == "int4":
-            # Sharded params flow through GSPMD (plain jit + NamedSharding),
-            # which pallas_call cannot partition — pin the SPMD-shardable
-            # XLA lowering for the int4 matmuls (ops/quant4.py).
-            from substratus_tpu.ops.quant4 import set_q4_impl
+        # The Pallas kernels (int4 unpack-dequant matmul, fused/unfused
+        # decode attention) carry custom_partitioning rules, so they run
+        # per-shard under GSPMD — sharded serving no longer pins the XLA
+        # fallbacks (round-4 gap). params.json {"q4_impl": "xla"} remains
+        # the escape hatch.
+    q4_impl = params_json.get("q4_impl")
+    if q4_impl:
+        from substratus_tpu.ops.quant4 import set_q4_impl
 
-            set_q4_impl("xla")
-        impl = getattr(cfg, "decode_attn_impl", "xla")
-        if impl != "xla":
-            # Same GSPMD limitation for the Pallas decode kernels (fused
-            # or unfused): no SPMD partitioning rule, so sharded serving
-            # falls back to the xla path (loudly, matching the
-            # resolve_kv_layout policy).
-            print(
-                f"decode_attn_impl={impl} is single-chip; sharded serving "
-                "falls back to xla decode",
-                flush=True,
-            )
-            cfg = cfg.replace(decode_attn_impl="xla")
+        if q4_impl not in ("xla", "pallas"):
+            raise SystemExit(f"q4_impl {q4_impl!r} invalid (xla|pallas)")
+        set_q4_impl(q4_impl)
+        print(f"int4 lowering pinned: {q4_impl}", flush=True)
     # Speculative decoding: a small draft model (same family) proposes,
     # the target verifies — engine-integrated, batched (serve/engine.py).
     draft = None
@@ -288,8 +282,38 @@ def main(argv=None) -> int:
         ec.spec_k = spec_k
         print(f"speculative decoding: prompt-lookup k={spec_k}", flush=True)
 
-    engine = Engine(cfg, params, ec, mesh=mesh, model=family, draft=draft)
+    # Multi-host slice: every process builds the same engine over the
+    # global mesh and runs the scheduler in lockstep; only process 0
+    # binds HTTP (the Service routes to worker 0), followers mirror the
+    # computation via the per-iteration event broadcast
+    # (serve/multihost.py).
+    sync = None
+    if jax.process_count() > 1:
+        from substratus_tpu.serve.multihost import StepSync
+
+        sync = StepSync()
+        print(
+            f"multi-host serving: process {sync.process_index}/"
+            f"{sync.num_processes} "
+            f"({'leader' if sync.leader else 'follower'})",
+            flush=True,
+        )
+
+    engine = Engine(
+        cfg, params, ec, mesh=mesh, model=family, draft=draft, sync=sync
+    )
     engine.start()
+    if sync is not None and not sync.leader:
+        # Follower: no HTTP. Mirror the leader's scheduler until it
+        # broadcasts stop (or the process is torn down with the gang).
+        # A crashed follower must exit NON-zero: a Succeeded gang pod
+        # would suppress the JobSet failurePolicy restart while the
+        # leader hangs at its next collective missing a participant.
+        engine._thread.join()
+        if engine.error is not None:
+            print(f"follower engine died: {engine.error!r}", flush=True)
+            return 1
+        return 0
     state = ServerState(engine, tokenizer, model_name)
     print(f"serving {model_name} on {args.host}:{args.port}", flush=True)
     serve_forever(state, host=args.host, port=args.port)

@@ -1,0 +1,136 @@
+"""Multi-host serving lockstep (leader/follower request broadcast).
+
+A multi-host TPU slice runs one engine process per host, all of them
+jointly executing every jitted computation over the global mesh (JAX's
+multi-controller SPMD model). That only works if every process issues
+IDENTICAL jit calls in IDENTICAL order — but only process 0 has the HTTP
+server and therefore knows which requests exist. This module closes the
+gap with a *replicated scheduler*:
+
+  * process 0 (the leader) owns HTTP + the request queue. At the top of
+    every scheduler iteration it serializes the iteration's events — new
+    requests (full admission parameters), cancellation latches, shutdown
+    — and broadcasts them to all processes;
+  * every process (leader included) then applies those events to an
+    identical local scheduler state and runs the exact same iteration
+    code. All scheduler decisions (slot choice, paging, preemption,
+    speculation accept/reject) are deterministic functions of the event
+    stream plus device values that the engine pins to a fully-replicated
+    layout (engine._replicated), so the processes cannot diverge;
+  * followers attach a null token sink where the leader has the HTTP
+    response queue: they compute everything and deliver nothing.
+
+The broadcast rides `multihost_utils.broadcast_one_to_all` — an XLA
+collective over ICI/DCN, the same fabric the decode collectives use, so
+the control plane needs no extra network plumbing (the reference's
+serving images were single-pod and never faced this problem; SURVEY.md
+§2.2, reference internal/controller/server_controller.go).
+
+Cost: ONE fixed-size collective per scheduler iteration for the common
+case — the message rides a fixed buffer with its length in the first
+four bytes — and a second, bucket-padded collective only when a burst of
+long prompts overflows it. Fixed buffer sizes mean each shape compiles
+once.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class NullSink:
+    """Follower-side stand-in for Request.out: accepts and drops tokens.
+    Followers mirror the full scheduler, so _emit runs on them too — the
+    tokens just have nowhere to go (the leader answers the HTTP call)."""
+
+    def put(self, item) -> None:  # queue.Queue interface subset
+        pass
+
+
+def _bucket_bytes(n: int, lo: int = 256) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def struct_pack_u32(n: int) -> bytes:
+    import struct
+
+    return struct.pack("<I", n)
+
+
+class StepSync:
+    """Per-iteration event broadcast for lockstep multi-host serving."""
+
+    def __init__(self) -> None:
+        import jax
+
+        self.process_index = jax.process_index()
+        self.num_processes = jax.process_count()
+        self.leader = self.process_index == 0
+
+    # Inline buffer: 4-byte length prefix + payload. Sized so a typical
+    # iteration (a few requests, cancels, or the idle heartbeat) is one
+    # collective.
+    INLINE = 1024
+
+    def broadcast(self, payload: Optional[bytes]) -> bytes:
+        """Leader sends `payload`; every process returns it. The message
+        rides one fixed-size collective (length embedded in the first 4
+        bytes); only payloads overflowing the inline buffer pay a second,
+        bucket-padded collective — every process derives the same
+        collective count from the first buffer, so the gang stays in
+        lockstep."""
+        if self.num_processes == 1:
+            return payload or b""
+        from jax.experimental import multihost_utils
+
+        payload = payload or b""
+        n = len(payload)
+        cap = self.INLINE - 4
+        buf = np.zeros((self.INLINE,), np.uint8)
+        if self.leader:
+            buf[:4] = np.frombuffer(struct_pack_u32(n), np.uint8)
+            buf[4 : 4 + min(n, cap)] = np.frombuffer(
+                payload[:cap], np.uint8
+            )
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        n = int(out[:4].view(np.uint32)[0])
+        if n <= cap:
+            return bytes(out[4 : 4 + n].tobytes())
+        size = _bucket_bytes(n)
+        big = np.zeros((size,), np.uint8)
+        if self.leader:
+            big[:n] = np.frombuffer(payload, np.uint8)
+        out2 = np.asarray(multihost_utils.broadcast_one_to_all(big))
+        return bytes(out2[:n].tobytes())
+
+
+def encode_events(reqs: List[Any], cancels: List[int], stop: bool) -> bytes:
+    """Iteration events -> wire bytes. `reqs` carry every field admission
+    reads, so a follower's mirror Request behaves identically."""
+    return json.dumps(
+        {
+            "stop": stop,
+            "cancels": cancels,
+            "reqs": [
+                {
+                    "sid": r.sync_id,
+                    "p": list(r.prompt_tokens),
+                    "m": r.max_tokens,
+                    "t": r.temperature,
+                    "tp": r.top_p,
+                    "e": r.eos_token_id,
+                    "id": r.id,
+                }
+                for r in reqs
+            ],
+        }
+    ).encode()
+
+
+def decode_events(payload: bytes) -> Dict[str, Any]:
+    return json.loads(payload.decode())

@@ -182,6 +182,89 @@ def test_server_flow(env):
     assert srv["status"]["ready"] is True
 
 
+def test_server_multihost_tpu_serving_gang(env):
+    """A Server asking for a multi-host slice (the examples/llama2-70b
+    v5e-16 shape) must become a lockstep serving gang — JobSet +
+    headless rendezvous Service + a front Service routing ONLY to
+    worker 0 — not a Deployment whose single pod could never span 4
+    hosts. Ready tracks the leader pod's Ready condition."""
+    client, cloud, sci, mgr = env
+    client.create(_model(name="llama70"))
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "srv70", "namespace": "default"},
+            "spec": {
+                "image": "img:70",
+                "model": {"name": "llama70"},
+                "resources": {
+                    "tpu": {"type": "v5e", "chips": 16, "topology": "4x4"}
+                },
+            },
+        }
+    )
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "llama70-modeller")
+    mgr.run_until_idle()
+
+    # No Deployment: the gang replaces it entirely.
+    from substratus_tpu.kube.client import NotFound
+
+    with pytest.raises(NotFound):
+        client.get("Deployment", "default", "srv70-server")
+
+    js = client.get("JobSet", "default", "srv70-server-gang")
+    job_tmpl = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job_tmpl["completions"] == 4 and job_tmpl["parallelism"] == 4
+    assert job_tmpl["completionMode"] == "Indexed"
+    pod = job_tmpl["template"]["spec"]
+    # Serving gang: containers restart in place; gang recreation is the
+    # JobSet failure policy's job.
+    assert pod["restartPolicy"] == "OnFailure"
+    assert js["spec"]["failurePolicy"]["maxRestarts"] >= 100
+    c = pod["containers"][0]
+    env_names = {e["name"] for e in c["env"]}
+    assert {"TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES"} <= env_names
+    assert c["readinessProbe"]["httpGet"]["path"] == "/"
+
+    # Headless rendezvous Service + front Service pinned to worker 0.
+    # The front keeps the single-host `{name}-server` address.
+    headless = client.get("Service", "default", "srv70-server-gang")
+    assert headless["spec"]["clusterIP"] == "None"
+    front = client.get("Service", "default", "srv70-server")
+    sel = front["spec"]["selector"]
+    assert sel["jobset.sigs.k8s.io/jobset-name"] == "srv70-server-gang"
+    assert sel["batch.kubernetes.io/job-completion-index"] == "0"
+    assert front["spec"]["ports"][0]["targetPort"] == "http-serve"
+
+    srv = client.get("Server", "default", "srv70")
+    assert srv["status"]["ready"] is False
+
+    # Fake the data plane: the gang's leader pod comes up and passes its
+    # readiness probe -> the Server goes ready.
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "srv70-server-gang-workers-0-0",
+                "namespace": "default",
+                "labels": {
+                    "jobset.sigs.k8s.io/jobset-name": "srv70-server-gang",
+                    "batch.kubernetes.io/job-completion-index": "0",
+                },
+            },
+            "spec": {"containers": [{"name": "server", "image": "img:70"}]},
+        }
+    )
+    client.mark_pod_ready("default", "srv70-server-gang-workers-0-0")
+    mgr.run_until_idle()
+    srv = client.get("Server", "default", "srv70")
+    assert srv["status"]["ready"] is True
+
+
 def test_notebook_suspend_resume(env):
     client, cloud, sci, mgr = env
     client.create(

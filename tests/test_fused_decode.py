@@ -241,3 +241,39 @@ def test_drifted_position_quantized_scale_and_row_agree():
     np.testing.assert_allclose(a_drift, a_ref, atol=2e-6)
     for key in kv_ref:
         np.testing.assert_array_equal(kv_drift[key], kv_ref[key])
+
+
+def test_fused_decode_engine_under_mesh():
+    """Round-5: the fused kernel's custom_partitioning rule keeps it
+    per-shard under a (data x tensor) serving mesh — the engine with
+    kv_layout=dense + decode_attn_impl=fused over 4 devices must be
+    token-exact vs the single-device xla engine (previously sharded
+    serving force-pinned xla; serve/main.py r4)."""
+    from substratus_tpu.models import llama
+    from substratus_tpu.parallel.mesh import build_mesh
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(
+        vocab_size=258, dtype=jnp.float32, decode_attn_impl="xla"
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompts = [[256, 5, 6, 7], [256, 70, 71]]
+    ec = lambda: EngineConfig(
+        max_batch=4, max_seq_len=64, eos_token_id=257, kv_layout="dense"
+    )
+
+    def run(engine):
+        engine.start()
+        try:
+            return [
+                engine.generate(p, max_tokens=6, temperature=0.0)
+                for p in prompts
+            ]
+        finally:
+            engine.stop()
+
+    single = run(Engine(cfg, params, ec()))
+    fused_cfg = cfg.replace(decode_attn_impl="fused")
+    mesh = build_mesh(data=2, tensor=2, fsdp=2)
+    sharded = run(Engine(fused_cfg, params, ec(), mesh=mesh))
+    assert sharded == single, (sharded, single)

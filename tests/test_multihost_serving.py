@@ -1,0 +1,121 @@
+"""Multi-host lockstep serving: a 2-process jax.distributed gang over a
+4-device CPU mesh must generate EXACTLY what the single-process engine
+generates (greedy and sampled), with the follower mirroring every
+scheduler step and exiting cleanly on the leader's stop broadcast.
+
+This is the CPU stand-in for the v5e-16 multi-host Server deployment
+(examples/llama2-70b): same engine, same StepSync broadcast, same
+leader/follower roles — the reference never had multi-host serving at
+all (its Server was one pod, internal/controller/server_controller.go)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from substratus_tpu.models import llama
+from substratus_tpu.serve.engine import Engine, EngineConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tools", "multihost_serve_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_gang(tmp_path, extra=()):
+    port = _free_port()
+    procs, outs = [], []
+    for pid in range(2):
+        out = tmp_path / f"out{pid}.json"
+        outs.append(out)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, WORKER,
+                    "--pid", str(pid), "--nprocs", "2",
+                    "--coord", f"127.0.0.1:{port}",
+                    "--out", str(out), *extra,
+                ],
+                env=_worker_env(),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    for p, out in zip(procs, outs):
+        stdout, stderr = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{stderr[-3000:]}"
+        results.append(json.loads(out.read_text()))
+    return results
+
+
+def _single_process_reference(spec_k=0):
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    ec = EngineConfig(
+        max_batch=4, max_seq_len=64, eos_token_id=257, spec_k=spec_k
+    )
+    engine = Engine(cfg, params, ec)
+    engine.start()
+    try:
+        return [
+            engine.generate([256, 5, 6, 7], max_tokens=6, temperature=0.0),
+            engine.generate([256, 70, 71], max_tokens=6, temperature=0.0),
+            engine.generate([256, 9, 10], max_tokens=6, temperature=0.7),
+        ]
+    finally:
+        engine.stop()
+
+
+def test_two_process_gang_token_exact(tmp_path):
+    expected = _single_process_reference()
+    results = _run_gang(tmp_path)
+    leader = next(r for r in results if r["leader"])
+    follower = next(r for r in results if not r["leader"])
+    assert leader["outs"] == expected, (leader["outs"], expected)
+    # The follower mirrored the whole run and exited on the stop
+    # broadcast without an engine error.
+    assert follower["stopped"] is True
+    assert follower["error"] is None
+
+
+def test_two_process_gang_speculative(tmp_path):
+    """Prompt-lookup speculation under lockstep: the proposal scan is
+    host-side, so leader and follower must derive identical proposals
+    from their mirrored slot histories."""
+    expected = _single_process_reference(spec_k=3)
+    results = _run_gang(tmp_path, extra=("--spec-k", "3"))
+    leader = next(r for r in results if r["leader"])
+    assert leader["outs"] == expected, (leader["outs"], expected)
+
+
+def test_two_process_cancellation(tmp_path):
+    """Mid-generation cancellation latches through the broadcast: the
+    gang must stay in lockstep (no hang, clean follower exit) when the
+    leader cancels a request partway."""
+    results = _run_gang(tmp_path, extra=("--cancel-after", "3"))
+    leader = next(r for r in results if r["leader"])
+    follower = next(r for r in results if not r["leader"])
+    # Cancellation is cooperative: at least the tokens before the cancel
+    # arrived, and the request stopped short of max_tokens=24. (The exact
+    # stop point depends on when the latch broadcast lands, so only the
+    # budget bound is asserted — a tight bound would flake on slow CI.)
+    assert 3 <= len(leader["outs"][1]) < 24, leader["outs"][1]
+    assert follower["stopped"] is True and follower["error"] is None

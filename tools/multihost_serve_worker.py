@@ -1,0 +1,96 @@
+"""One process of a multi-host lockstep serving gang (CPU test worker).
+
+Launched N times by tests/test_multihost_serving.py (and usable by hand)
+to prove the leader/follower serving path end-to-end without TPU
+hardware: each process joins a jax.distributed world, builds the same
+engine over the global mesh, and the leader's generations must be
+token-exact vs a single-process engine.
+
+    python tools/multihost_serve_worker.py \
+        --pid 0 --nprocs 2 --coord 127.0.0.1:9911 --out /tmp/out0.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coord", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--spec-k", type=int, default=0)
+    ap.add_argument("--cancel-after", type=int, default=0,
+                    help="cancel the 2nd request after this many tokens")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=args.coord,
+        num_processes=args.nprocs,
+        process_id=args.pid,
+    )
+
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.parallel.mesh import build_mesh
+    from substratus_tpu.serve.engine import Engine, EngineConfig, Request
+    from substratus_tpu.serve.multihost import StepSync
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    n = len(jax.devices())
+    assert n % 2 == 0, n
+    mesh = build_mesh(data=n // 2, tensor=2)
+    ec = EngineConfig(
+        max_batch=4, max_seq_len=64, eos_token_id=257, spec_k=args.spec_k
+    )
+    sync = StepSync()
+    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync)
+    engine.start()
+
+    result = {"pid": args.pid, "leader": sync.leader}
+    if sync.leader:
+        outs = []
+        # Two sequential greedy generations + one sampled (deterministic:
+        # fixed key, lockstep iteration order).
+        outs.append(engine.generate([256, 5, 6, 7], max_tokens=6,
+                                    temperature=0.0))
+        if args.cancel_after:
+            req = engine.submit(Request([256, 70, 71], max_tokens=24,
+                                        temperature=0.0))
+            got = []
+            while True:
+                tok = req.out.get(timeout=120)
+                if tok is None:
+                    break
+                got.append(tok)
+                if len(got) >= args.cancel_after:
+                    req.cancelled = True
+            outs.append(got)
+        else:
+            outs.append(engine.generate([256, 70, 71], max_tokens=6,
+                                        temperature=0.0))
+        outs.append(engine.generate([256, 9, 10], max_tokens=6,
+                                    temperature=0.7))
+        result["outs"] = outs
+        result["stats"] = dict(engine.stats)
+        engine.stop()
+    else:
+        engine._thread.join(timeout=600)
+        result["stopped"] = not engine._thread.is_alive()
+        result["error"] = repr(engine.error) if engine.error else None
+
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+    print("worker done", args.pid, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

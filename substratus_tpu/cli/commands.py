@@ -17,6 +17,7 @@ import argparse
 import hashlib
 import json
 import os
+import re
 import sys
 import tarfile
 import time
@@ -27,6 +28,7 @@ from typing import Optional
 import yaml
 
 from substratus_tpu.api.types import KIND_OF_PLURAL, KINDS, PLURALS
+from substratus_tpu.kube.client import NotFound
 
 _FAKE_ENV = None
 
@@ -273,6 +275,40 @@ def upload_context(args, client, doc, progress=None):
         progress(0, size)
     request_id = uuid.uuid4().hex
     doc.setdefault("metadata", {}).setdefault("namespace", args.namespace)
+    ns0 = doc["metadata"]["namespace"]
+    if getattr(args, "increment", False):
+        # -i: create `{name}-{N+1}` next to the highest existing
+        # `{name}-N` (reference tui/common.go nextModelVersion /
+        # nextDatasetVersion — iterate-and-version, never overwrite).
+        base = doc["metadata"]["name"]
+        pat = re.compile(re.escape(base) + r"-(\d+)$")
+        highest = 0
+        for item in client.list(doc["kind"], ns0):
+            m = pat.fullmatch(item["metadata"]["name"])
+            if m:
+                highest = max(highest, int(m.group(1)))
+        doc["metadata"]["name"] = f"{base}-{highest + 1}"
+        if progress is None:
+            print(f"next version: {doc['metadata']['name']}")
+    elif getattr(args, "replace", False):
+        # -r: delete any existing object so the new build context starts
+        # a fresh lifecycle (reference common.go:192-201 delete-and-
+        # recreate). Validate the new manifest BEFORE deleting — a
+        # malformed replacement must not destroy the old object and its
+        # cascade-owned children.
+        from substratus_tpu.kube.schema import SchemaError, validate
+
+        try:
+            validate(doc)
+        except SchemaError as e:
+            raise SystemExit(f"--replace refused: new manifest invalid: {e}")
+        try:
+            client.delete(doc["kind"], ns0, doc["metadata"]["name"])
+            if progress is None:
+                print(f"replaced existing {doc['kind'].lower()}/"
+                      f"{doc['metadata']['name']}")
+        except NotFound:
+            pass
     doc.setdefault("spec", {})["build"] = {
         "upload": {"md5Checksum": md5, "requestId": request_id}
     }
@@ -512,6 +548,15 @@ def register(sub) -> None:
     )
     p.add_argument("-f", "--filename", required=True)
     p.add_argument("-d", "--dir", default=".")
+    vg = p.add_mutually_exclusive_group()
+    vg.add_argument(
+        "-i", "--increment", action="store_true",
+        help="create {name}-{N+1} next to the highest existing {name}-N",
+    )
+    vg.add_argument(
+        "-r", "--replace", action="store_true",
+        help="delete an existing object of the same name first",
+    )
     common(p)
     p.set_defaults(func=cmd_run)
 

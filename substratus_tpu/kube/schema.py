@@ -508,6 +508,35 @@ JOBSET_STATUS = obj(
 )
 
 
+RBAC_RULE = obj(
+    {
+        "apiGroups": arr(STR), "resources": arr(STR), "verbs": arr(STR),
+        "resourceNames": arr(STR), "nonResourceURLs": arr(STR),
+    },
+    required=("verbs",),
+)
+
+RBAC_SUBJECT = obj(
+    {"kind": STR, "name": STR, "namespace": STR, "apiGroup": STR},
+    required=("kind", "name"),
+)
+
+RBAC_ROLE_REF = obj(
+    {"apiGroup": STR, "kind": STR, "name": STR}, required=("kind", "name")
+)
+
+DAEMONSET_SPEC = obj(
+    {
+        "selector": LABEL_SELECTOR,
+        "template": POD_TEMPLATE,
+        "updateStrategy": OPEN,
+        "minReadySeconds": INT,
+        "revisionHistoryLimit": INT,
+    },
+    required=("selector", "template"),
+)
+
+
 def _sections(spec: Optional[Dict] = None, status: Optional[Dict] = None,
               **extra: Dict) -> Dict[str, Any]:
     props: Dict[str, Any] = {}
@@ -550,6 +579,29 @@ REGISTRY: Dict[str, Tuple[str, Dict[str, Any]]] = {
     # apiserver's job, not a controller-emission surface — keep it open.
     "CustomResourceDefinition": ("apiextensions.k8s.io/v1",
                                  _sections(OPEN, OPEN)),
+    # Install/config-manifest kinds (install/substratus-tpu.yaml,
+    # config/*): validated by tests/test_install_manifests.py so a typo
+    # in the shipped YAML fails CI instead of a live kubectl apply.
+    "Namespace": ("v1", _sections(obj({"finalizers": arr(STR)}), OPEN)),
+    "ClusterRole": (
+        "rbac.authorization.k8s.io/v1",
+        _sections(rules=arr(RBAC_RULE), aggregationRule=OPEN),
+    ),
+    "ClusterRoleBinding": (
+        "rbac.authorization.k8s.io/v1",
+        _sections(subjects=arr(RBAC_SUBJECT), roleRef=RBAC_ROLE_REF),
+    ),
+    "Role": (
+        "rbac.authorization.k8s.io/v1", _sections(rules=arr(RBAC_RULE))
+    ),
+    "RoleBinding": (
+        "rbac.authorization.k8s.io/v1",
+        _sections(subjects=arr(RBAC_SUBJECT), roleRef=RBAC_ROLE_REF),
+    ),
+    "DaemonSet": ("apps/v1", _sections(DAEMONSET_SPEC, OPEN)),
+    # Prometheus-operator CRD: not a core type; shape is the operator's
+    # contract, keep open like CustomResourceDefinition.
+    "ServiceMonitor": ("monitoring.coreos.com/v1", _sections(OPEN)),
 }
 
 

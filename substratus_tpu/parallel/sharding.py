@@ -92,6 +92,23 @@ SERVE_RULES = DEFAULT_RULES.replace(
 )
 
 
+def serve_rules_for(mesh: Optional[Mesh]) -> LogicalRules:
+    """SERVE_RULES, with the KV cache's sequence dim sharded over the
+    mesh's "sequence" axis when the serving mesh has one (>1): serving-
+    side context parallelism. A long-context dense cache then spreads
+    over sequence shards — per-chip cache memory drops N×, and XLA's
+    partitioner turns the attention softmax over the sharded dim into
+    the max/sum collectives (the decode analogue of training's ring
+    attention; SURVEY.md §5 long-context)."""
+    if (
+        mesh is not None
+        and "sequence" in mesh.shape
+        and mesh.shape["sequence"] > 1
+    ):
+        return SERVE_RULES.replace(cache_seq="sequence")
+    return SERVE_RULES
+
+
 def spec_for(logical: Sequence[Optional[str]], rules: LogicalRules = DEFAULT_RULES) -> P:
     return rules.mesh_axes(logical)
 

@@ -181,10 +181,13 @@ class Engine:
 
         self.mesh = mesh
         if mesh is not None:
-            from substratus_tpu.parallel.sharding import SERVE_RULES, shard_tree
+            from substratus_tpu.parallel.sharding import (
+                serve_rules_for, shard_tree,
+            )
 
+            self._serve_rules = serve_rules_for(mesh)
             self.params = shard_tree(
-                params, mesh, model.param_logical_axes(cfg), SERVE_RULES
+                params, mesh, model.param_logical_axes(cfg), self._serve_rules
             )
 
         if self.paged:
@@ -220,7 +223,7 @@ class Engine:
                     pool,
                     mesh,
                     model.paged_cache_logical_axes(cfg, quantized=kv_int8),
-                    SERVE_RULES,
+                    self._serve_rules,
                 )
             self.cache = pool
             self.block_table = np.zeros((B, self.max_pages), np.int32)
@@ -234,7 +237,7 @@ class Engine:
                 model.init_cache(cfg, B, S, dtype=cache_dtype),
                 mesh,
                 model.cache_logical_axes(cfg, quantized=kv_int8),
-                SERVE_RULES,
+                self._serve_rules,
             )
         else:
             self.cache = model.init_cache(cfg, B, S, dtype=cache_dtype)
@@ -288,20 +291,22 @@ class Engine:
         self.spec = bool(ec.spec_k)
         # draft model proposer, or prompt-lookup when no draft is given
         self.spec_draft = self.spec and draft is not None
-        if self.spec and not self.paged:
-            raise ValueError("spec_k requires the paged kv layout")
+        if self.spec_draft and not self.paged:
+            # The draft shares the target's page tables; a dense draft
+            # cache has no insert path. Prompt-lookup speculation is
+            # layout-agnostic (host-side proposals + a multi-token
+            # verify), which is what lets it stack with the dense-only
+            # fused decode kernel.
+            raise ValueError("draft-model spec_k requires the paged kv layout")
         if self.spec_draft:
             self.draft_cfg, draft_params = draft
             self.draft_params = draft_params
             if mesh is not None:
-                from substratus_tpu.parallel.sharding import (
-                    SERVE_RULES,
-                    shard_tree,
-                )
+                from substratus_tpu.parallel.sharding import shard_tree
 
                 self.draft_params = shard_tree(
                     draft_params, mesh,
-                    model.param_logical_axes(self.draft_cfg), SERVE_RULES,
+                    model.param_logical_axes(self.draft_cfg), self._serve_rules,
                 )
             # Same KV dtype as the target pool: an int8 configuration means
             # int8 for the draft's (larger-per-token-count) traffic too.
@@ -315,7 +320,7 @@ class Engine:
                     model.paged_cache_logical_axes(
                         self.draft_cfg, quantized=kv_int8
                     ),
-                    SERVE_RULES,
+                    self._serve_rules,
                 )
             self.draft_cache = draft_pool
 
@@ -424,7 +429,7 @@ class Engine:
         return out if len(out) > 1 else out[0]
 
     def _build_verify(self):
-        cfg, ec, model = self.cfg, self.ec, self.model
+        cfg, ec, model, paged = self.cfg, self.ec, self.model, self.paged
 
         @partial(jax.jit, donate_argnums=(1,))
         def verify(params, cache, block_table, block_tokens, positions0,
@@ -439,7 +444,7 @@ class Engine:
             )
             logits, cache = model.forward(
                 params, block_tokens, cfg, positions=positions, cache=cache,
-                block_table=block_table,
+                **({"block_table": block_table} if paged else {}),
             )
             choices = logits.argmax(-1).astype(jnp.int32)
             key, subkey = jax.random.split(jax.random.wrap_key_data(key_data))
@@ -1000,15 +1005,17 @@ class Engine:
             if not lookup_matched.any():
                 self._decode_step()
                 return
-        for slot in np.flatnonzero(self.active):
-            self._ensure_capacity(
-                int(slot), int(self.host_positions[slot]) + k
-            )
-        if not self.active.any():
-            return
+        if self.paged:
+            for slot in np.flatnonzero(self.active):
+                self._ensure_capacity(
+                    int(slot), int(self.host_positions[slot]) + k
+                )
+            if not self.active.any():
+                return
+        bt = self.block_table if self.paged else None
         if self.spec_draft:
             proposals, self.draft_cache = self._propose_fn(
-                self.draft_params, self.draft_cache, self.block_table,
+                self.draft_params, self.draft_cache, bt,
                 self.tokens, self.positions,
             )
             props = np.asarray(proposals)
@@ -1016,7 +1023,7 @@ class Engine:
             props = lookup_props
         block = np.concatenate([self.tokens[:, None], props], axis=1)
         choices, sampled, self.cache, key_out = self._verify_fn(
-            self.params, self.cache, self.block_table, block,
+            self.params, self.cache, bt, block,
             self.positions, self.temps, self.top_ps, self.key,
         )
         self.key = np.asarray(key_out)

@@ -123,7 +123,7 @@ def main(argv=None) -> int:
             "model", "config", "quantize", "max_batch", "max_seq_len",
             "max_prefill_len", "kv_cache_dtype", "kv_layout", "attn_impl",
             "chunk_attn_impl", "decode_attn_impl", "q4_impl", "tensor",
-            "replicas", "draft_model", "spec_k",
+            "sequence", "replicas", "draft_model", "spec_k",
         ),
         "serve.main",
     )
@@ -225,13 +225,40 @@ def main(argv=None) -> int:
     if n_dev > 1:
         from substratus_tpu.parallel.mesh import build_mesh
 
-        tp = int(params_json.get("tensor", 0)) or min(n_dev, cfg.n_kv_heads)
-        while n_dev % tp or cfg.n_kv_heads % tp:
+        # Serving-side context parallelism: {"sequence": N} shards the
+        # dense KV cache's sequence dim over N chips (per-chip cache
+        # memory drops N×; XLA partitions the attention softmax over the
+        # sharded dim — parallel/sharding.serve_rules_for).
+        sp = int(params_json.get("sequence", 1)) or 1
+        if n_dev % sp:
+            raise SystemExit(
+                f"sequence={sp} must divide the device count ({n_dev})"
+            )
+        rest = n_dev // sp
+        tp = int(params_json.get("tensor", 0)) or min(rest, cfg.n_kv_heads)
+        while rest % tp or cfg.n_kv_heads % tp:
             tp -= 1
-        mesh = build_mesh(data=n_dev // tp, tensor=tp)
-        if max_batch % (n_dev // tp):
-            ec.max_batch = ((max_batch // (n_dev // tp)) + 1) * (n_dev // tp)
-        print(f"serving mesh: data={n_dev // tp} tensor={tp}", flush=True)
+        dp = rest // tp
+        mesh = build_mesh(data=dp, sequence=sp, tensor=tp)
+        if max_batch % dp:
+            ec.max_batch = ((max_batch // dp) + 1) * dp
+        print(
+            f"serving mesh: data={dp} sequence={sp} tensor={tp}",
+            flush=True,
+        )
+        if sp > 1:
+            if kv_layout != "dense":
+                # The paged pool indexes pages host-side; only the dense
+                # layout sequence-shards.
+                print("sequence>1 pins kv_layout=dense", flush=True)
+                kv_layout = "dense"
+                ec.kv_layout = "dense"
+            if getattr(cfg, "decode_attn_impl", "xla") != "xla":
+                # The Pallas decode kernels' partition rules keep the
+                # cache sequence-replicated; with an S-sharded cache the
+                # XLA path is the one that partitions the softmax.
+                print("sequence>1 pins decode_attn_impl=xla", flush=True)
+                cfg = cfg.replace(decode_attn_impl="xla")
         # The Pallas kernels (int4 unpack-dequant matmul, fused/unfused
         # decode attention) carry custom_partitioning rules, so they run
         # per-shard under GSPMD — sharded serving no longer pins the XLA
@@ -254,12 +281,13 @@ def main(argv=None) -> int:
         if args.spec_k is not None
         else int(params_json.get("spec_k", 0))
     )
-    if spec_k and kv_layout == "dense":
-        # Speculation needs the paged pool; dense (e.g. forced by
-        # decode_attn_impl=fused) warns and serves unsped rather than
-        # crashing at Engine construction. Applies to draft AND
-        # prompt-lookup modes alike.
-        print("spec_k set but kv_layout=dense; speculation disabled",
+    if spec_k and draft_dir and kv_layout == "dense":
+        # Draft-model speculation shares the target's page tables, so it
+        # needs the paged pool; warn and serve unsped rather than crash
+        # at Engine construction. Prompt-lookup speculation is
+        # layout-agnostic and composes with the dense fused-decode
+        # kernel (int4 + fused + lookup stack in one config).
+        print("draft spec_k needs kv_layout=paged; speculation disabled",
               flush=True)
         spec_k = 0
     if draft_dir and spec_k:

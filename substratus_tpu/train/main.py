@@ -121,13 +121,20 @@ def main(argv=None) -> int:
     )
     dp_total = mesh.shape["data"] * mesh.shape["fsdp"]
     accum = max(1, int(p.get("grad_accum_steps", 1)))
-    # Each of the `accum` microbatches must itself split over data*fsdp.
+    nproc = jax.process_count()
+    # Each of the `accum` microbatches must itself split over data*fsdp,
+    # and the global batch must slice evenly across processes (each host
+    # loads only its own rows; train/data.py shard args below).
     unit = dp_total * accum
+    if unit % nproc:
+        import math
+
+        unit = unit * nproc // math.gcd(unit, nproc)
     if batch_size % unit:
         batch_size = ((batch_size // unit) + 1) * unit
         print(
-            f"batch_size rounded up to {batch_size} "
-            f"(multiple of data*fsdp*grad_accum_steps={unit})",
+            f"batch_size rounded up to {batch_size} (multiple of "
+            f"{unit} = lcm(data*fsdp*grad_accum_steps, processes))",
             flush=True,
         )
     # Context parallelism: {"sequence": N, "attn_impl": "ring"|"ulysses"}
@@ -153,9 +160,11 @@ def main(argv=None) -> int:
     )
     trainer = Trainer(cfg, tc, mesh, params=params)
     data = PackedDataset(
-        args.data, tokenizer, batch_size, seq_len,
+        args.data, tokenizer, batch_size // nproc, seq_len,
         eos_id=getattr(tokenizer, "eos_id", 0),
         seed=tc.seed,
+        shard=jax.process_index(),
+        num_shards=nproc,
     )
     print(
         f"training: {n_dev} devices, mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}, "

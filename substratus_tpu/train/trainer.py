@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -312,23 +313,38 @@ class Trainer:
         return jax.jit(train_step, donate_argnums=donate)
 
     def train_step(self, batch: Dict[str, jnp.ndarray]) -> float:
-        """batch: {"tokens": [B, S] int32, "weights": [B, S] 0/1}."""
-        b = batch["tokens"].shape[0]
+        """batch: {"tokens": [B, S] int32, "weights": [B, S] 0/1}.
+
+        Multi-process: B is the PER-PROCESS slice (global/N); the global
+        batch assembles from every process's local rows via
+        make_array_from_process_local_data, so no host ever materializes
+        (or needs to agree on) the whole batch."""
+        nproc = jax.process_count()
+        b = batch["tokens"].shape[0] * nproc
         dp = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         if b % dp:
             raise ValueError(
-                f"batch size {b} must be divisible by data*fsdp={dp} "
+                f"global batch size {b} must be divisible by data*fsdp={dp} "
                 f"(mesh {dict(self.mesh.shape)})"
             )
         accum = max(1, self.tc.grad_accum_steps)
         if b % accum or (b // accum) % dp:
             raise ValueError(
-                f"batch size {b} must split into grad_accum_steps={accum} "
-                f"microbatches each divisible by data*fsdp={dp}"
+                f"global batch size {b} must split into "
+                f"grad_accum_steps={accum} microbatches each divisible by "
+                f"data*fsdp={dp}"
             )
-        batch = jax.tree.map(
-            lambda x: jax.device_put(x, self.batch_sharding), batch
-        )
+        if nproc > 1:
+            batch = jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    self.batch_sharding, np.asarray(x)
+                ),
+                batch,
+            )
+        else:
+            batch = jax.tree.map(
+                lambda x: jax.device_put(x, self.batch_sharding), batch
+            )
         trainable = self.lora if self.lora is not None else self.params
         # Ambient mesh: the ring-attention path (cfg.attn_impl == "ring")
         # opens a shard_map over the "sequence" axis inside the jitted step.

@@ -1,0 +1,66 @@
+"""One process of a multi-host training gang (CPU test worker).
+
+Launched twice by tests/test_multihost_training.py: each process joins a
+jax.distributed world, loads ONLY its shard of the corpus
+(train/data.py round-robin source sharding), and assembles global
+batches from per-process rows (make_array_from_process_local_data in
+train/trainer.py). Losses must match the single-process run on the same
+corpus.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coord", required=True)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=args.coord,
+        num_processes=args.nprocs,
+        process_id=args.pid,
+    )
+
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.parallel.mesh import build_mesh
+    from substratus_tpu.serve.tokenizer import load_tokenizer
+    from substratus_tpu.train.data import PackedDataset
+    from substratus_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    mesh = build_mesh(fsdp=len(jax.devices()))
+    tc = TrainConfig(learning_rate=1e-3, warmup_steps=1, remat=False)
+    trainer = Trainer(cfg, tc, mesh)
+    tok = load_tokenizer(None)
+    data = PackedDataset(
+        args.data, tok, batch_size=4 // args.nprocs, seq_len=32,
+        eos_id=2, shard=args.pid, num_shards=args.nprocs, shuffle=False,
+    )
+    losses = []
+    it = iter(data)
+    for _ in range(args.steps):
+        losses.append(trainer.train_step(next(it)))
+
+    with open(args.out, "w") as f:
+        json.dump(
+            {"pid": args.pid, "losses": losses, "n_tokens": data.n_tokens},
+            f,
+        )
+    print("train worker done", args.pid, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -180,12 +180,8 @@ def _flash_forward(
     # Shrink blocks to divide the sequence (non-power-of-two prefill
     # buckets like 384 must not crash; a smaller block only costs a bit
     # of grid overhead).
-    block_q = min(block_q, sq)
-    while sq % block_q:
-        block_q //= 2
-    block_k = min(block_k, sk)
-    while sk % block_k:
-        block_k //= 2
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     nq, nk = sq // block_q, sk // block_k
 
     # [B, S, H, D] -> [B*H, S, D] view via BlockSpec index maps.
@@ -539,17 +535,68 @@ def flash_cached_attention(
     (chunked prefill / speculative verify): flash online softmax, int8
     cache operands converted block-at-a-time in VMEM (never a dequantized
     HBM copy), per-row masking at min(position, kv_length-1). Inference
-    only (no vjp). Returns [B, Sq, H, D] in q.dtype."""
+    only (no vjp). Returns [B, Sq, H, D] in q.dtype.
+
+    Local per (batch, kv-head) shard like every attention kernel here —
+    the custom_partitioning route keeps it per-shard under GSPMD."""
+    quantized = k_scale is not None
+    has_len = kv_length is not None
+    f = _cached_sp(quantized, has_len, block_q, block_k, interpret)
+    args = [q, k, v, q_positions]
+    if quantized:
+        args += [k_scale, v_scale]
+    if has_len:
+        args.append(kv_length)
+    return f(*args)
+
+
+def _cached_sp(quantized, has_len, block_q, block_k, interpret):
+    key = ("cached", quantized, has_len, block_q, block_k, interpret)
+    if key in _SP_CACHE:
+        return _SP_CACHE[key]
+    from substratus_tpu.ops.kernel_partition import bh_partitioned
+
+    def impl(*args):
+        i = 4 + (2 if quantized else 0)
+        ks, vs = (args[4], args[5]) if quantized else (None, None)
+        kvl = args[i] if has_len else None
+        return _cached_impl(
+            args[0], args[1], args[2], args[3], ks, vs, kvl,
+            block_q, block_k, interpret,
+        )
+
+    arg_dims = [(0, 2), (0, 1), (0, 1), (0, None)]
+    rule_in = ["b s h d", "b k s2 d2", "b k s3 d3", "b s4"]
+    if quantized:
+        arg_dims += [(0, 1), (0, 1)]
+        rule_in += ["b k s5", "b k s6"]
+    if has_len:
+        arg_dims.append((0, None))
+        rule_in.append("b")
+    f = bh_partitioned(
+        impl,
+        arg_dims=arg_dims,
+        out_dims=[(0, 2)],
+        sharding_rule=", ".join(rule_in) + " -> b s h d",
+        # The CACHE is the committed operand in sharded serving (q is an
+        # activation whose sharding is propagation-dependent) — same ref
+        # choice as fused_decode/_pallas_sp.
+        ref=1,
+    )
+    _SP_CACHE[key] = f
+    return f
+
+
+def _cached_impl(
+    q, k, v, q_positions, k_scale, v_scale, kv_length,
+    block_q, block_k, interpret,
+) -> jnp.ndarray:
     b, sq, h, d = q.shape
     kh, sk = k.shape[1], k.shape[2]
     assert h % kh == 0
     group = h // kh
-    block_q = min(block_q, sq)
-    while sq % block_q:
-        block_q //= 2
-    block_k = min(block_k, sk)
-    while sk % block_k:
-        block_k //= 2
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     nq, nk = sq // block_q, sk // block_k
     quantized = k_scale is not None
 
@@ -638,6 +685,85 @@ def _compiler_params():
     )
 
 
+# SPMD rules (ops/kernel_partition.py): every flash entry is local per
+# (batch, head) shard, so GSPMD runs the kernels per-shard under TP/DP
+# serving and training meshes instead of choking on the opaque
+# pallas_call. The custom_vjp sits OUTSIDE the partitioned cores, so
+# autodiff still sees the hand-written backward. Cached per static
+# configuration (wrappers carry compiled partition rules).
+_SP_CACHE: dict = {}
+
+
+def _fwd_sp(scale, causal, block_q, block_k, interpret, need_lse):
+    key = ("fwd", scale, causal, block_q, block_k, interpret, need_lse)
+    if key in _SP_CACHE:
+        return _SP_CACHE[key]
+    from substratus_tpu.ops.kernel_partition import bh_partitioned
+
+    if need_lse:
+        def impl(q, k, v):
+            out, lse = _flash_forward(
+                q, k, v, scale, causal, block_q, block_k, interpret,
+                need_lse=True,
+            )
+            b, sq, h, _ = q.shape
+            # lse leaves the core as [B, H, Sq, 8] so its head axis can
+            # shard like q's.
+            return out, lse.reshape(b, h, sq, 8)
+
+        f = bh_partitioned(
+            impl,
+            arg_dims=[(0, 2), (0, 2), (0, 2)],
+            out_dims=[(0, 2), (0, 1)],
+            sharding_rule=(
+                "b s h d, b s2 k d, b s3 k d -> b s h d, b h s4 e"
+            ),
+        )
+    else:
+        def impl(q, k, v):
+            out, _ = _flash_forward(
+                q, k, v, scale, causal, block_q, block_k, interpret,
+                need_lse=False,
+            )
+            return out
+
+        f = bh_partitioned(
+            impl,
+            arg_dims=[(0, 2), (0, 2), (0, 2)],
+            out_dims=[(0, 2)],
+            sharding_rule="b s h d, b s2 k d, b s3 k d -> b s h d",
+        )
+    _SP_CACHE[key] = f
+    return f
+
+
+def _bwd_sp(scale, causal, block_q, block_k, interpret):
+    key = ("bwd", scale, causal, block_q, block_k, interpret)
+    if key in _SP_CACHE:
+        return _SP_CACHE[key]
+    from substratus_tpu.ops.kernel_partition import bh_partitioned
+
+    def impl(q, k, v, out, lse4, g):
+        b, sq, h, _ = q.shape
+        lse = lse4.reshape(b * h, sq, 8)
+        return _flash_backward(
+            q, k, v, out, lse, g, scale, causal, block_q, block_k,
+            interpret,
+        )
+
+    f = bh_partitioned(
+        impl,
+        arg_dims=[(0, 2), (0, 2), (0, 2), (0, 2), (0, 1), (0, 2)],
+        out_dims=[(0, 2), (0, 2), (0, 2)],
+        sharding_rule=(
+            "b s h d, b s2 k d, b s3 k d, b s4 h d, b h s5 e, b s6 h d "
+            "-> b s h d, b s2 k d, b s3 k d"
+        ),
+    )
+    _SP_CACHE[key] = f
+    return f
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
@@ -655,27 +781,26 @@ def flash_attention(
     (no-cache) path. Shapes [B, S, H|KH, D]."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    out, _ = _flash_forward(
-        q, k, v, scale, causal, block_q, block_k, interpret, need_lse=False
+    return _fwd_sp(scale, causal, block_q, block_k, interpret, False)(
+        q, k, v
     )
-    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    out, lse = _flash_forward(
-        q, k, v, scale, causal, block_q, block_k, interpret
+    out, lse4 = _fwd_sp(scale, causal, block_q, block_k, interpret, True)(
+        q, k, v
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse4)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse4 = res
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_backward(
-        q, k, v, out, lse, g, scale, causal, block_q, block_k, interpret
+    return _bwd_sp(scale, causal, block_q, block_k, interpret)(
+        q, k, v, out, lse4, g
     )
 
 

@@ -13,10 +13,12 @@ from typing import Dict, List, Optional
 
 @dataclass
 class BuildGit:
-    """Build the container image from a git repo (ref: common_types.go Build.Git)."""
+    """Build the container image from a git repo (ref: common_types.go
+    Build.Git — tag OR branch, pulled at build time only)."""
 
     url: str = ""
     branch: Optional[str] = None
+    tag: Optional[str] = None
     path: Optional[str] = None  # subdir containing Dockerfile
 
 

@@ -51,6 +51,17 @@ class BuildReconciler:
         build = spec.get("build")
         if not build:
             return Result()
+        git = build.get("git") or {}
+        if git.get("tag") and git.get("branch"):
+            # Tag OR branch, never both (reference common_types.go:32-47)
+            # — cloning one silently while the user believes the other
+            # was built is the worst outcome, so reject loudly.
+            set_condition(
+                obj, C.CONDITION_BUILT, False, C.REASON_INVALID_SPEC,
+                "build.git: set tag OR branch, not both",
+            )
+            write_status(self.client, obj)
+            return Result()
 
         class _Ref:
             KIND = obj["kind"]
@@ -174,8 +185,12 @@ class BuildReconciler:
         if build.get("git"):
             git = build["git"]
             clone = ["git", "clone", "--depth=1"]
-            if git.get("branch"):
-                clone += ["--branch", git["branch"]]
+            # `--branch` accepts tags too (detached HEAD) — one flag
+            # covers both BuildGit refs (reference common_types.go:32-47:
+            # tag OR branch, pulled at build time only).
+            ref = git.get("tag") or git.get("branch")
+            if ref:
+                clone += ["--branch", ref]
             clone += [git["url"], "/workspace/repo"]
             init_containers.append(
                 {

@@ -75,14 +75,13 @@ _PALLAS_SP_CACHE: dict = {}
 
 
 def _pallas_sp(quantized: bool, block_s: int, interpret):
-    """custom_partitioning wrapper for the unfused decode kernel: same
-    per-(batch, kv-head) locality argument as fused_decode._fused_sp;
-    the cache's committed sharding names the batch/head mesh axes and
-    every operand/result spec follows from it."""
+    """SPMD rule for the unfused decode kernel (ops/kernel_partition.py):
+    same per-(batch, kv-head) locality argument as fused_decode._fused_sp;
+    the cache (index 1) is the committed reference."""
     key = (quantized, block_s, interpret)
     if key in _PALLAS_SP_CACHE:
         return _PALLAS_SP_CACHE[key]
-    from jax.experimental.custom_partitioning import custom_partitioning
+    from substratus_tpu.ops.kernel_partition import bh_partitioned
 
     def impl_fn(*args):
         if quantized:
@@ -93,49 +92,17 @@ def _pallas_sp(quantized: bool, block_s: int, interpret):
             q, k, v, pos, ks, vs, block_s=block_s, interpret=interpret
         )
 
-    f = custom_partitioning(impl_fn)
-
-    def specs(arg_shapes):
-        from jax.sharding import PartitionSpec as P
-
-        ck = arg_shapes[1]  # cache k [B, KH, S, D]
-        spec = getattr(ck.sharding, "spec", None) or ()
-        spec = tuple(spec) + (None,) * (4 - len(spec))
-        b, h = spec[0], spec[1]
-        args = [
-            P(b, None, h, None),  # q
-            P(b, h, None, None),  # k
-            P(b, h, None, None),  # v
-            P(b),                 # positions
-        ]
-        if quantized:
-            args += [P(b, h, None), P(b, h, None)]  # k_scale, v_scale
-        return args, P(b, None, h, None)
-
-    def infer(mesh, arg_shapes, result_shape):
-        from jax.sharding import NamedSharding
-
-        _, out = specs(arg_shapes)
-        return NamedSharding(mesh, out)
-
-    def partition(mesh, arg_shapes, result_shape):
-        from jax.sharding import NamedSharding
-
-        args, out = specs(arg_shapes)
-        return (
-            mesh,
-            impl_fn,
-            NamedSharding(mesh, out),
-            tuple(NamedSharding(mesh, s) for s in args),
-        )
-
-    rule = (
-        "b u h d, b k s d, b k s d, b, b k s2, b k s3 -> b u h d"
-        if quantized
-        else "b u h d, b k s d, b k s d, b -> b u h d"
-    )
-    f.def_partition(
-        partition, infer_sharding_from_operands=infer, sharding_rule=rule
+    arg_dims = [(0, 2), (0, 1), (0, 1), (0, None)]  # q, k, v, positions
+    rule_in = ["b u h d", "b k s d", "b k s d", "b"]
+    if quantized:
+        arg_dims += [(0, 1), (0, 1)]  # k_scale, v_scale
+        rule_in += ["b k s2", "b k s3"]
+    f = bh_partitioned(
+        impl_fn,
+        arg_dims=arg_dims,
+        out_dims=[(0, 2)],
+        sharding_rule=", ".join(rule_in) + " -> b u h d",
+        ref=1,
     )
     _PALLAS_SP_CACHE[key] = f
     return f

@@ -325,27 +325,18 @@ def _fused_impl(
     return attn, ck, cv
 
 
-def _spec_dim(sharding, i):
-    spec = getattr(sharding, "spec", None)
-    if spec is None or i >= len(spec):
-        return None
-    return spec[i]
-
-
 _FUSED_SP_CACHE: dict = {}
 
 
 def _fused_sp(quantized: bool, block_s: int, interpret):
-    """custom_partitioning wrapper (cached per configuration): the
-    kernel is local once batch and kv-head axes shard — every operand
-    either carries those axes or is per-batch (positions) — so the
-    partition rule just pins per-shard execution with consistent specs
-    derived from the cache's committed sharding. Sequence and head-dim
-    axes are forced unsharded (serving never shards them)."""
+    """SPMD rule (ops/kernel_partition.py): the kernel is local once
+    batch and kv-head axes shard — every operand either carries those
+    axes or is per-batch (positions). The cache (index 3) is the
+    committed reference; sequence and head-dim axes stay unsharded."""
     key = (quantized, block_s, interpret)
     if key in _FUSED_SP_CACHE:
         return _FUSED_SP_CACHE[key]
-    from jax.experimental.custom_partitioning import custom_partitioning
+    from substratus_tpu.ops.kernel_partition import bh_partitioned
 
     def impl(*args):
         if quantized:
@@ -359,75 +350,28 @@ def _fused_sp(quantized: bool, block_s: int, interpret):
             q, nk, nv, ck, cv, pos, block_s=block_s, interpret=interpret,
         )
 
-    f = custom_partitioning(impl)
-
-    def axes(arg_shapes):
-        # cache_k (index 3) is the committed reference: [B, KH, S, D]
-        ck = arg_shapes[3]
-        b_axis = _spec_dim(ck.sharding, 0)
-        h_axis = _spec_dim(ck.sharding, 1)
-        return b_axis, h_axis
-
-    def arg_specs(b, h):
-        from jax.sharding import PartitionSpec as P
-
-        base = [
-            P(b, None, h, None),  # q
-            P(b, h, None, None),  # new_k
-            P(b, h, None, None),  # new_v
-            P(b, h, None, None),  # cache_k
-            P(b, h, None, None),  # cache_v
-            P(b),                 # positions
-        ]
-        if quantized:
-            base += [
-                P(b, h, None),    # new_ks
-                P(b, h, None),    # new_vs
-                P(b, h, None),    # cache_ks
-                P(b, h, None),    # cache_vs
-            ]
-        return base
-
-    def out_specs(b, h):
-        from jax.sharding import PartitionSpec as P
-
-        return [
-            P(b, None, h, None),  # attn
-            P(b, h, None, None),  # cache_k'
-            P(b, h, None, None),  # cache_v'
-        ]
-
-    def infer(mesh, arg_shapes, result_shape):
-        from jax.sharding import NamedSharding
-
-        b, h = axes(arg_shapes)
-        return tuple(NamedSharding(mesh, s) for s in out_specs(b, h))
-
-    def partition(mesh, arg_shapes, result_shape):
-        from jax.sharding import NamedSharding
-
-        b, h = axes(arg_shapes)
-        result_shardings = tuple(
-            NamedSharding(mesh, s) for s in out_specs(b, h)
-        )
-        arg_shardings = tuple(
-            NamedSharding(mesh, s) for s in arg_specs(b, h)
-        )
-        return mesh, impl, result_shardings, arg_shardings
-
-    rule = (
-        "b u h d, b k v d, b k w d, b k s d, b k s d, b, "
-        "b k v2, b k w2, b k s2, b k s3 "
-        "-> b u h d, b k s d, b k s d"
-        if quantized
-        else
-        "b u h d, b k v d, b k w d, b k s d, b k s d, b "
-        "-> b u h d, b k s d, b k s d"
-    )
-    f.def_partition(
-        partition,
-        infer_sharding_from_operands=infer,
-        sharding_rule=rule,
+    arg_dims = [
+        (0, 2),     # q [B, 1, H, D]
+        (0, 1),     # new_k [B, KH, 1, D]
+        (0, 1),     # new_v
+        (0, 1),     # cache_k [B, KH, S, D]
+        (0, 1),     # cache_v
+        (0, None),  # positions [B]
+    ]
+    rule_in = [
+        "b u h d", "b k v d", "b k w d", "b k s d", "b k s d", "b",
+    ]
+    if quantized:
+        arg_dims += [(0, 1)] * 4  # new_ks, new_vs, cache_ks, cache_vs
+        rule_in += ["b k v2", "b k w2", "b k s2", "b k s3"]
+    f = bh_partitioned(
+        impl,
+        arg_dims=arg_dims,
+        out_dims=[(0, 2), (0, 1), (0, 1)],  # attn, cache_k', cache_v'
+        sharding_rule=(
+            ", ".join(rule_in) + " -> b u h d, b k s d, b k s d"
+        ),
+        ref=3,
     )
     _FUSED_SP_CACHE[key] = f
     return f

@@ -57,11 +57,14 @@ class EngineConfig:
     # if the pool runs dry mid-decode.
     kv_pool_tokens: Optional[int] = None
     prefix_cache: bool = True  # share full prompt-prefix pages across requests
-    # Speculative decoding (paged layout only): a proposer guesses spec_k
-    # greedy tokens per iteration and ONE target forward verifies all of
+    # Speculative decoding: a proposer guesses spec_k greedy tokens per
+    # iteration and ONE target forward verifies all of
     # them — decode is HBM-bound, so accepted tokens amortize the weight
     # stream. With draft=(cfg, params) at Engine construction the proposer
-    # is the draft model; WITHOUT one it is prompt-lookup decoding (the
+    # is the draft model (paged layout only — the draft shares the
+    # target's page tables); WITHOUT one it is prompt-lookup decoding
+    # (layout-agnostic, so it stacks with the dense-only fused kernel;
+    # the
     # continuation after the most recent match of the context's trailing
     # n-gram — zero extra model cost, wins on repetitive outputs:
     # summarization, RAG, code edits). Greedy slots stay token-exact
@@ -131,10 +134,16 @@ class Engine:
         implementing forward/init_cache/param_logical_axes/cache_logical_axes.
 
         mesh: optional jax Mesh for sharded serving. Params are laid out
-        by parallel.sharding.SERVE_RULES (tensor-parallel heads/mlp/vocab,
-        data-parallel batch); the KV cache shards the same way, so decode
-        collectives ride ICI. Constraint: the tensor axis must divide
-        n_kv_heads (llama2-70b: KH=8 => tensor<=8 per data replica)."""
+        by parallel.sharding.serve_rules_for(mesh) (tensor-parallel
+        heads/mlp/vocab, data-parallel batch, and — when the mesh has a
+        "sequence" axis — the dense KV cache's length dim for serving-
+        side context parallelism); the KV cache shards the same way, so
+        decode collectives ride ICI. Constraint: the tensor axis must
+        divide n_kv_heads (llama2-70b: KH=8 => tensor<=8 per replica).
+
+        sync: serve.multihost.StepSync for multi-host lockstep serving —
+        process 0 owns HTTP + the queue and broadcasts per-iteration
+        events; followers mirror the scheduler (see serve/multihost.py)."""
         import dataclasses as _dc
 
         # Copy the config before clamping: mutating a caller's (or the

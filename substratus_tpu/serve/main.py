@@ -253,12 +253,18 @@ def main(argv=None) -> int:
                 print("sequence>1 pins kv_layout=dense", flush=True)
                 kv_layout = "dense"
                 ec.kv_layout = "dense"
+            # The Pallas attention kernels' partition rules keep the
+            # cache sequence-REPLICATED (their online softmax is local
+            # per shard); with an S-sharded cache the XLA paths are the
+            # ones whose softmax GSPMD partitions over the sequence —
+            # otherwise every chunk would silently all-gather the cache
+            # and forfeit SP's N-times memory win.
             if getattr(cfg, "decode_attn_impl", "xla") != "xla":
-                # The Pallas decode kernels' partition rules keep the
-                # cache sequence-replicated; with an S-sharded cache the
-                # XLA path is the one that partitions the softmax.
                 print("sequence>1 pins decode_attn_impl=xla", flush=True)
                 cfg = cfg.replace(decode_attn_impl="xla")
+            if getattr(cfg, "chunk_attn_impl", "xla") != "xla":
+                print("sequence>1 pins chunk_attn_impl=xla", flush=True)
+                cfg = cfg.replace(chunk_attn_impl="xla")
         # The Pallas kernels (int4 unpack-dequant matmul, fused/unfused
         # decode attention) carry custom_partitioning rules, so they run
         # per-shard under GSPMD — sharded serving no longer pins the XLA

@@ -292,6 +292,81 @@ def test_notebook_suspend_resume(env):
     assert client.get("Notebook", "default", "nb")["status"]["ready"] is False
 
 
+def test_build_git_flow(env):
+    """spec.build.git (reference common_types.go Build.Git +
+    build_reconciler.go:272): the builder Job clones the repo (tag or
+    branch ref, depth 1) in an init container and kaniko builds from the
+    cloned path; job completion flips Built and stamps spec.image."""
+    client, cloud, sci, mgr = env
+    client.create(
+        _model(
+            name="gitmodel",
+            image=None,
+            build={
+                "git": {
+                    "url": "https://example.com/org/repo",
+                    "path": "models/llama",
+                    "tag": "v1.2.3",
+                }
+            },
+        )
+    )
+    mgr.run_until_idle()
+
+    jobs = [
+        j for j in client.list("Job", "default")
+        if j["metadata"]["name"].startswith("gitmodel")
+    ]
+    assert jobs, "no builder job emitted"
+    tmpl = jobs[0]["spec"]["template"]["spec"]
+    clone = tmpl["initContainers"][0]
+    assert clone["command"][:3] == ["git", "clone", "--depth=1"]
+    assert "--branch" in clone["command"]
+    assert clone["command"][clone["command"].index("--branch") + 1] == "v1.2.3"
+    assert clone["command"][-2] == "https://example.com/org/repo"
+    kaniko = tmpl["containers"][0]
+    assert any(
+        a == "--context=dir:///workspace/repo/models/llama"
+        for a in kaniko["args"]
+    ), kaniko["args"]
+
+    client.mark_job_complete("default", jobs[0]["metadata"]["name"])
+    mgr.run_until_idle()
+    live = client.get("Model", "default", "gitmodel")
+    conds = {c["type"]: c for c in live["status"]["conditions"]}
+    assert conds["Built"]["status"] == "True"
+    assert live["spec"]["image"]  # stamped by the build reconciler
+
+
+def test_build_git_tag_and_branch_rejected(env):
+    """tag AND branch together is ambiguous — the reconciler parks the
+    object with an InvalidSpec condition instead of silently building
+    one of them."""
+    client, cloud, sci, mgr = env
+    client.create(
+        _model(
+            name="bothrefs",
+            image=None,
+            build={
+                "git": {
+                    "url": "https://example.com/org/repo",
+                    "branch": "main",
+                    "tag": "v1",
+                }
+            },
+        )
+    )
+    mgr.run_until_idle()
+    live = client.get("Model", "default", "bothrefs")
+    conds = {c["type"]: c for c in live["status"]["conditions"]}
+    assert conds["Built"]["status"] == "False"
+    assert conds["Built"]["reason"] == "InvalidSpec"
+    assert not [
+        j for j in client.list("Job", "default")
+        if j["metadata"]["name"].startswith("bothrefs")
+    ]
+
+
 def test_build_upload_flow(env):
     client, cloud, sci, mgr = env
     client.create(

@@ -25,6 +25,12 @@ def main() -> int:
     ap.add_argument("--spec-k", type=int, default=0)
     ap.add_argument("--cancel-after", type=int, default=0,
                     help="cancel the 2nd request after this many tokens")
+    ap.add_argument("--long-prompt", action="store_true",
+                    help="use a >1KB-on-the-wire prompt so the event "
+                         "broadcast takes the two-collective overflow path")
+    ap.add_argument("--sp", action="store_true",
+                    help="sequence-parallel dense mesh (sequence=2 x "
+                         "tensor=2) instead of data x tensor")
     args = ap.parse_args()
 
     import jax
@@ -46,20 +52,36 @@ def main() -> int:
     params = llama.init_params(cfg, jax.random.key(0))
     n = len(jax.devices())
     assert n % 2 == 0, n
-    mesh = build_mesh(data=n // 2, tensor=2)
-    ec = EngineConfig(
-        max_batch=4, max_seq_len=64, eos_token_id=257, spec_k=args.spec_k
-    )
+    if args.sp:
+        # Lockstep + serving-side context parallelism combined: the
+        # dense cache's sequence dim shards across the gang.
+        mesh = build_mesh(sequence=2, tensor=n // 2)
+        ec = EngineConfig(
+            max_batch=4, max_seq_len=256, eos_token_id=257,
+            kv_layout="dense", spec_k=args.spec_k,
+        )
+    else:
+        mesh = build_mesh(data=n // 2, tensor=2)
+        ec = EngineConfig(
+            max_batch=4, max_seq_len=256 if args.long_prompt else 64,
+            eos_token_id=257, spec_k=args.spec_k,
+        )
     sync = StepSync()
     engine = Engine(cfg, params, ec, mesh=mesh, sync=sync)
     engine.start()
 
     result = {"pid": args.pid, "leader": sync.leader}
+    first_prompt = [256, 5, 6, 7]
+    if args.long_prompt:
+        # ~200 tokens -> ~1.1KB of JSON on the wire: exceeds
+        # StepSync.INLINE, forcing the header+payload two-collective
+        # path that short-prompt tests never touch.
+        first_prompt = [256] + [(7 + 13 * i) % 250 for i in range(200)]
     if sync.leader:
         outs = []
         # Two sequential greedy generations + one sampled (deterministic:
         # fixed key, lockstep iteration order).
-        outs.append(engine.generate([256, 5, 6, 7], max_tokens=6,
+        outs.append(engine.generate(first_prompt, max_tokens=6,
                                     temperature=0.0))
         if args.cancel_after:
             req = engine.submit(Request([256, 70, 71], max_tokens=24,

@@ -242,6 +242,13 @@ def jobset_from_pod(
         "spec": {
             "clusterIP": "None",
             "selector": {"jobset.sigs.k8s.io/jobset-name": name},
+            # Rendezvous DNS must exist BEFORE pods are Ready: serving
+            # gang followers never pass the HTTP readiness probe (only
+            # worker 0 binds :8080), and worker 0 itself cannot become
+            # ready until jax.distributed rendezvous — which needs this
+            # Service's records — completes. Without this flag the gang
+            # deadlocks at bootstrap on a real cluster.
+            "publishNotReadyAddresses": True,
         },
     }
     jobset: Obj = {

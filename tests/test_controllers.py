@@ -230,9 +230,12 @@ def test_server_multihost_tpu_serving_gang(env):
     assert c["readinessProbe"]["httpGet"]["path"] == "/"
 
     # Headless rendezvous Service + front Service pinned to worker 0.
-    # The front keeps the single-host `{name}-server` address.
+    # The front keeps the single-host `{name}-server` address. DNS must
+    # publish before readiness (followers never pass the HTTP probe and
+    # rendezvous precedes worker-0 readiness).
     headless = client.get("Service", "default", "srv70-server-gang")
     assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["publishNotReadyAddresses"] is True
     front = client.get("Service", "default", "srv70-server")
     sel = front["spec"]["selector"]
     assert sel["jobset.sigs.k8s.io/jobset-name"] == "srv70-server-gang"

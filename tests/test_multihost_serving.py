@@ -165,3 +165,20 @@ def test_two_process_sequence_parallel_gang(tmp_path):
     follower = next(r for r in results if not r["leader"])
     assert leader["outs"][:2] == expected[:2], (leader["outs"], expected)
     assert follower["stopped"] is True and follower["error"] is None
+
+
+def test_leader_crash_broadcasts_stop(tmp_path):
+    """Failure propagation, leader->followers: a crashed leader loop
+    must best-effort-broadcast stop so followers exit their mirror loop
+    cleanly (engine error intact on the leader, request finished with
+    reason \"error\") instead of hanging forever in the next collective
+    (code-review r5 high finding, now under test)."""
+    results = _run_gang(tmp_path, extra=("--crash-leader",))
+    leader = next(r for r in results if r["leader"])
+    follower = next(r for r in results if not r["leader"])
+    assert leader["error"] and "injected leader crash" in leader["error"]
+    assert leader["crash_finish_reason"] == "error"
+    # the follower exited via the stop broadcast — not a hang/timeout —
+    # and its own engine saw no error
+    assert follower["stopped"] is True
+    assert follower["error"] is None

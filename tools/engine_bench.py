@@ -23,6 +23,25 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=24)
     ap.add_argument("--max-seq-len", type=int, default=512)
     ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
+    ap.add_argument(
+        "--quantize", default="int8", choices=["int8", "int4"],
+        help="weight quantization for the random params",
+    )
+    ap.add_argument(
+        "--kv-layout", default="auto", choices=["auto", "paged", "dense"]
+    )
+    ap.add_argument(
+        "--decode-impl", default="xla", choices=["xla", "pallas", "fused"],
+        help="decode attention path (fused requires --kv-layout dense)",
+    )
+    ap.add_argument(
+        "--spec-k", type=int, default=0,
+        help="prompt-lookup speculation (repetitive prompts benefit)",
+    )
+    ap.add_argument(
+        "--repetitive", action="store_true",
+        help="prompts made of repeated n-grams so lookup speculation hits",
+    )
     a = ap.parse_args()
 
     # Honor an explicit JAX_PLATFORMS=cpu even under an injected
@@ -39,9 +58,20 @@ def main() -> int:
     from substratus_tpu.serve.engine import Engine, EngineConfig
 
     cfg = llama.CONFIGS[a.config]
-    params = jax.jit(lambda k: random_quantized_params(cfg, k))(
-        jax.random.key(0)
-    )
+    if a.decode_impl != "xla":
+        # The Pallas/fused decode kernels live on the dense slot-cache
+        # path; the paged decode never consults decode_attn_impl — same
+        # policy as serve.main.resolve_kv_layout, enforced so the
+        # printed metric is never mislabeled.
+        if a.kv_layout == "paged":
+            raise SystemExit(
+                f"--decode-impl {a.decode_impl} requires --kv-layout dense"
+            )
+        a.kv_layout = "dense"
+        cfg = cfg.replace(decode_attn_impl=a.decode_impl)
+    params = jax.jit(
+        lambda k: random_quantized_params(cfg, k, a.quantize)
+    )(jax.random.key(0))
     jax.tree.leaves(params)[0].block_until_ready()
 
     ec = EngineConfig(
@@ -49,15 +79,26 @@ def main() -> int:
         max_seq_len=a.max_seq_len,
         max_prefill_len=min(256, a.max_seq_len),
         kv_cache_dtype=a.kv_dtype,
+        kv_layout=a.kv_layout,
+        spec_k=a.spec_k,
     )
     engine = Engine(cfg, params, ec)
     engine.start()
 
     rng = np.random.default_rng(0)
-    prompts = [
-        rng.integers(10, cfg.vocab_size - 1, a.prompt_len).tolist()
-        for _ in range(a.requests)
-    ]
+    if a.repetitive:
+        # Repeated n-grams: the prompt-lookup proposer's best case
+        # (summarization/RAG-shaped workloads).
+        gram = rng.integers(10, cfg.vocab_size - 1, 8).tolist()
+        reps = -(-a.prompt_len // len(gram))
+        prompts = [
+            (gram * reps)[: a.prompt_len] for _ in range(a.requests)
+        ]
+    else:
+        prompts = [
+            rng.integers(10, cfg.vocab_size - 1, a.prompt_len).tolist()
+            for _ in range(a.requests)
+        ]
 
     # Warm the executables (prefill bucket + decode) outside the clock.
     engine.generate(prompts[0][:16], max_tokens=2, temperature=0.0)
@@ -83,11 +124,24 @@ def main() -> int:
 
     gen_tokens = sum(done)
     total_tokens = gen_tokens + a.requests * a.prompt_len
+    spec = ""
+    if a.spec_k:
+        s = engine.stats
+        acc = (
+            s["spec_accepted"] / s["spec_proposed"]
+            if s["spec_proposed"] else 0.0
+        )
+        spec = (
+            f", \"spec_k\": {a.spec_k}, \"acceptance\": {acc:.3f}, "
+            f"\"verify_passes\": {s['verify_passes']}"
+        )
     print(
         f"{{\"metric\": \"{a.config.replace('-', '_')}_engine_throughput\", "
         f"\"value\": {gen_tokens / dt:.1f}, \"unit\": \"gen_tokens/sec\", "
         f"\"total_tok_s\": {total_tokens / dt:.1f}, "
-        f"\"requests\": {a.requests}, \"wall_s\": {dt:.2f}}}"
+        f"\"quantize\": \"{a.quantize}\", \"kv_layout\": \"{a.kv_layout}\", "
+        f"\"decode_impl\": \"{a.decode_impl}\", "
+        f"\"requests\": {a.requests}, \"wall_s\": {dt:.2f}{spec}}}"
     )
     return 0
 

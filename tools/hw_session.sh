@@ -53,11 +53,19 @@ if [ "${1:-}" != "quick" ]; then
   run bench_int4       python bench.py --quantize int4 --no-fallback
   run bench_int4_fused python bench.py --quantize int4 --decode-impl fused --no-fallback
   run bench_int8_fused python bench.py --quantize int8 --decode-impl fused --no-fallback
+  # Engine-level aggregate throughput: the number an HTTP user sees,
+  # including the r5 stacked config (int4 + fused flash-decode + prompt-
+  # lookup speculation on the dense layout) on a lookup-friendly
+  # workload.
+  run engine_int8      python tools/engine_bench.py
+  run engine_stacked   python tools/engine_bench.py --quantize int4 \
+                         --kv-layout dense --decode-impl fused \
+                         --spec-k 4 --repetitive
 fi
 
 echo
 echo "captured JSON lines:"
-grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null || true
+grep -h '"metric"' "$OUT"/bench_*.log "$OUT"/engine_*.log 2>/dev/null || true
 echo "next: copy the numbers into ROUND_NOTES.md + docs/performance.md"
 # Nonzero when any step failed so a watcher/CI wrapper can keep retrying.
 exit "$FAILURES"

@@ -31,6 +31,10 @@ def main() -> int:
     ap.add_argument("--sp", action="store_true",
                     help="sequence-parallel dense mesh (sequence=2 x "
                          "tensor=2) instead of data x tensor")
+    ap.add_argument("--crash-leader", action="store_true",
+                    help="poison the leader's decode fn after the first "
+                         "generation: its loop must die AND broadcast "
+                         "stop so followers exit cleanly")
     args = ap.parse_args()
 
     import jax
@@ -77,7 +81,24 @@ def main() -> int:
         # StepSync.INLINE, forcing the header+payload two-collective
         # path that short-prompt tests never touch.
         first_prompt = [256] + [(7 + 13 * i) % 250 for i in range(200)]
-    if sync.leader:
+    if sync.leader and args.crash_leader:
+        outs = [engine.generate(first_prompt, max_tokens=6,
+                                temperature=0.0)]
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected leader crash")
+
+        engine._decode_fn = boom
+        req = engine.submit(Request([256, 70, 71], max_tokens=6))
+        while req.out.get(timeout=120) is not None:
+            pass
+        result.update(
+            outs=outs,
+            crash_finish_reason=req.finish_reason,
+            error=repr(engine.error) if engine.error else None,
+        )
+        engine._thread.join(timeout=60)
+    elif sync.leader:
         outs = []
         # Two sequential greedy generations + one sampled (deterministic:
         # fixed key, lockstep iteration order).

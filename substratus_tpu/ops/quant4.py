@@ -393,13 +393,20 @@ def _q4_mm_sp(x2, p2, s2, block: int):
 _FORCE_IMPL: Optional[str] = os.environ.get("SUBSTRATUS_Q4_IMPL") or None
 
 
-def set_q4_impl(impl: Optional[str]) -> None:
+def set_q4_impl(impl: Optional[str]) -> Optional[str]:
     """Force the q4einsum lowering: "pallas", "xla", or None for auto
     (pallas on a TPU backend — sharded or not, via the
-    custom_partitioning rule — xla elsewhere)."""
+    custom_partitioning rule — xla elsewhere). Returns the previous
+    value so callers can save/restore without touching internals."""
     global _FORCE_IMPL
     assert impl in (None, "pallas", "xla"), impl
+    prev = _FORCE_IMPL
     _FORCE_IMPL = impl
+    return prev
+
+
+def get_q4_impl() -> Optional[str]:
+    return _FORCE_IMPL
 
 
 def _use_pallas() -> bool:

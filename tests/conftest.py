@@ -59,3 +59,46 @@ def mesh8():
     from substratus_tpu.parallel.mesh import build_mesh
 
     return build_mesh(data=2, fsdp=2, tensor=2)
+
+
+def run_gang(worker_path, tmp_path, extra=(), nprocs=2, devs_per_proc=2,
+             timeout=900):
+    """Launch a jax.distributed gang of `nprocs` worker subprocesses and
+    collect their JSON result files. One harness for every multihost
+    test (serving, training, 70B north-star)."""
+    import json
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devs_per_proc}"
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs, outs = [], []
+    for pid in range(nprocs):
+        out = tmp_path / f"gang{pid}.json"
+        outs.append(out)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, str(worker_path),
+                    "--pid", str(pid), "--nprocs", str(nprocs),
+                    "--coord", f"127.0.0.1:{port}",
+                    "--out", str(out), *extra,
+                ],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+    results = []
+    for p, out in zip(procs, outs):
+        _, stderr = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"gang worker failed:\n{stderr[-3000:]}"
+        results.append(json.loads(out.read_text()))
+    return results

@@ -7,16 +7,13 @@ This is the CPU stand-in for the v5e-16 multi-host Server deployment
 (examples/llama2-70b): same engine, same StepSync broadcast, same
 leader/follower roles — the reference never had multi-host serving at
 all (its Server was one pod, internal/controller/server_controller.go)."""
-import json
 import os
-import socket
-import subprocess
-import sys
 
 import jax
 import jax.numpy as jnp
 import pytest
 
+from conftest import run_gang
 from substratus_tpu.models import llama
 from substratus_tpu.serve.engine import Engine, EngineConfig
 
@@ -24,46 +21,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tools", "multihost_serve_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _worker_env() -> dict:
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    return env
-
-
 def _run_gang(tmp_path, extra=()):
-    port = _free_port()
-    procs, outs = [], []
-    for pid in range(2):
-        out = tmp_path / f"out{pid}.json"
-        outs.append(out)
-        procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable, WORKER,
-                    "--pid", str(pid), "--nprocs", "2",
-                    "--coord", f"127.0.0.1:{port}",
-                    "--out", str(out), *extra,
-                ],
-                env=_worker_env(),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    results = []
-    for p, out in zip(procs, outs):
-        stdout, stderr = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker failed:\n{stderr[-3000:]}"
-        results.append(json.loads(out.read_text()))
-    return results
+    return run_gang(WORKER, tmp_path, extra=extra, timeout=600)
 
 
 def _reference_outs(

@@ -3,26 +3,18 @@ tokenizes/holds only its half of the corpus must train to the same
 losses as a single process holding all of it (global batches assemble
 from per-process rows; round-4 VERDICT weak #5 — previously every host
 materialized the whole corpus and relied on identical-RNG draws)."""
-import json
 import os
-import socket
-import subprocess
-import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import run_gang
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tools", "multihost_train_worker.py")
 SEQ = 32
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _make_corpus(tmp_path):
@@ -60,32 +52,9 @@ def test_two_process_training_loss_parity(tmp_path):
     data_dir = _make_corpus(tmp_path)
     want, full_tokens = _single_process_losses(data_dir)
 
-    port = _free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    procs, outs = [], []
-    for pid in range(2):
-        out = tmp_path / f"train{pid}.json"
-        outs.append(out)
-        procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable, WORKER,
-                    "--pid", str(pid), "--nprocs", "2",
-                    "--coord", f"127.0.0.1:{port}",
-                    "--data", str(data_dir), "--out", str(out),
-                ],
-                env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE, text=True,
-            )
-        )
-    results = []
-    for p, out in zip(procs, outs):
-        _, stderr = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker failed:\n{stderr[-3000:]}"
-        results.append(json.loads(out.read_text()))
+    results = run_gang(
+        WORKER, tmp_path, extra=("--data", str(data_dir)), timeout=600
+    )
 
     # Corpus-larger-than-one-host-shard: each worker holds only its half
     # (2 of 4 blocks), NOT the whole corpus.

@@ -1,0 +1,122 @@
+"""The north-star topology end-to-end on CPU: multi-host lockstep serving
+of the 70B-structure config over a 16-device tensor=16 mesh spanning TWO
+jax.distributed processes (8 virtual devices each) — the exact shape of
+examples/llama2-70b/server.yaml on a v5e-16 slice (4 hosts x 4 chips;
+two hosts here, same code path: serve/multihost.py lockstep + global-mesh
+GSPMD + int4 weights + paged KV + prompt-lookup speculation).
+
+Worker (launched twice by tests/test_multihost_70b.py):
+    python tools/serve_70b_multihost.py --pid 0 --nprocs 2 \
+        --coord 127.0.0.1:9911 --out /tmp/out0.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROMPTS = [
+    [256] + list(range(2, 50)),     # 48 tokens -> chunked prefill
+    [256] + list(range(100, 140)),  # 40 tokens
+    [256, 5, 6, 7],                 # short
+    [256] + list(range(2, 50)),     # shared prefix with prompt 0
+]
+
+
+def scaled_70b_cfg():
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+
+    # Same scaled-down-but-structure-exact config as tools/serve_70b_cpu:
+    # H=64, KH=8 (GQA 8), mlp and vocab dividing 16.
+    cfg = llama.CONFIGS["llama2-70b"].replace(
+        dim=512, n_layers=2, head_dim=8, hidden_dim=1024,
+        vocab_size=258, max_seq_len=256, dtype=jnp.float32,
+    )
+    assert cfg.n_heads == 64 and cfg.n_kv_heads == 8
+    return cfg
+
+
+def engine_config():
+    from substratus_tpu.serve.engine import EngineConfig
+
+    return EngineConfig(
+        max_batch=4, max_seq_len=128, max_prefill_len=32,
+        eos_token_id=257, kv_layout="paged", page_size=16,
+        prefix_cache=True, spec_k=3,
+    )
+
+
+def int4_params(cfg):
+    import jax
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.ops.quant4 import quantize4_params
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    return quantize4_params(params, llama.quant_contracting(cfg))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coord", required=True)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=args.coord,
+        num_processes=args.nprocs,
+        process_id=args.pid,
+    )
+    from substratus_tpu.ops.quant4 import set_q4_impl
+    from substratus_tpu.parallel.mesh import build_mesh
+    from substratus_tpu.serve.engine import Engine
+    from substratus_tpu.serve.multihost import StepSync
+
+    set_q4_impl("xla")  # the SPMD lowering; kernel path tested elsewhere
+    cfg = scaled_70b_cfg()
+    qparams = int4_params(cfg)
+    n = len(jax.devices())
+    assert n == 16, f"need 16 global devices, got {n}"
+    mesh = build_mesh(tensor=16)
+
+    sync = StepSync()
+    engine = Engine(cfg, qparams, engine_config(), mesh=mesh, sync=sync)
+    engine.start()
+
+    result = {"pid": args.pid, "leader": sync.leader}
+    if sync.leader:
+        result["outs"] = [
+            engine.generate(p, max_tokens=8, temperature=0.0)
+            for p in PROMPTS
+        ]
+        result["stats"] = {
+            k: int(v) for k, v in engine.stats.items()
+        }
+        # the packed int4 nibbles really shard over the 2-process tensor
+        # axis (8 of 16 shards live on the other host)
+        result["wq_spec"] = str(
+            engine.params["layers"]["wq"].packed.sharding.spec
+        )
+        engine.stop()
+    else:
+        engine._thread.join(timeout=900)
+        result["stopped"] = not engine._thread.is_alive()
+        result["error"] = repr(engine.error) if engine.error else None
+
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+    print("70b multihost worker done", args.pid, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

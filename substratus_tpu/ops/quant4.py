@@ -405,10 +405,6 @@ def set_q4_impl(impl: Optional[str]) -> Optional[str]:
     return prev
 
 
-def get_q4_impl() -> Optional[str]:
-    return _FORCE_IMPL
-
-
 def _use_pallas() -> bool:
     if _FORCE_IMPL is not None:
         return _FORCE_IMPL == "pallas"

@@ -97,8 +97,19 @@ def run_gang(worker_path, tmp_path, extra=(), nprocs=2, devs_per_proc=2,
             )
         )
     results = []
-    for p, out in zip(procs, outs):
-        _, stderr = p.communicate(timeout=timeout)
-        assert p.returncode == 0, f"gang worker failed:\n{stderr[-3000:]}"
-        results.append(json.loads(out.read_text()))
+    try:
+        for p, out in zip(procs, outs):
+            _, stderr = p.communicate(timeout=timeout)
+            assert p.returncode == 0, (
+                f"gang worker failed:\n{stderr[-3000:]}"
+            )
+            results.append(json.loads(out.read_text()))
+    finally:
+        # One worker failing must not orphan the rest blocked in the
+        # distributed rendezvous/broadcast (they'd hold the port and CPU
+        # for the init timeout).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     return results

@@ -1,10 +1,11 @@
 """North-star topology proof on CPU: the 70B-structure config served
-int4 over a 16-device tensor=16 mesh SPANNING TWO jax.distributed
-processes — lockstep leader/follower, paged KV, prefix cache, chunked
-prefill, prompt-lookup speculation, all at once — must be token-exact vs
-the single-device int4 engine. This is examples/llama2-70b/server.yaml's
-exact execution shape (BASELINE.json north_star) minus only the real
-chips."""
+int4 over a 16-device tensor=16 mesh spanning MULTIPLE jax.distributed
+processes — 2 hosts x 8 devices AND the literal v5e-16 shape of 4 hosts
+x 4 chips — with lockstep leader/follower, paged KV, prefix cache,
+chunked prefill, and prompt-lookup speculation all at once, token-exact
+vs the single-device int4 engine. This is
+examples/llama2-70b/server.yaml's exact execution shape
+(BASELINE.json north_star) minus only the real chips."""
 import os
 import sys
 
@@ -17,6 +18,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tools", "serve_70b_multihost.py")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
 def _reference():
     sys.path.insert(0, os.path.join(REPO, "tools"))
     from serve_70b_multihost import (
@@ -42,18 +47,30 @@ def _reference():
         set_q4_impl(prev)
 
 
-def test_north_star_multihost_70b_token_exact(tmp_path):
+@pytest.mark.parametrize(
+    "nprocs,devs",
+    [
+        (2, 8),   # two hosts x 8 "chips"
+        (4, 4),   # the LITERAL v5e-16 topology: 4 hosts x 4 chips
+    ],
+    ids=["2x8", "4x4"],
+)
+def test_north_star_multihost_70b_token_exact(tmp_path, nprocs, devs):
     want = _reference()
     assert all(len(t) > 0 for t in want), want
 
-    results = run_gang(WORKER, tmp_path, devs_per_proc=8, timeout=900)
+    results = run_gang(
+        WORKER, tmp_path, nprocs=nprocs, devs_per_proc=devs, timeout=900
+    )
 
     leader = next(r for r in results if r["leader"])
-    follower = next(r for r in results if not r["leader"])
+    followers = [r for r in results if not r["leader"]]
+    assert len(followers) == nprocs - 1
     assert leader["outs"] == want, (leader["outs"], want)
     # int4 nibbles really shard over the cross-process tensor axis
     assert "tensor" in leader["wq_spec"], leader["wq_spec"]
     # prefix cache + speculation actually engaged
     assert leader["stats"]["prefix_hit_tokens"] > 0, leader["stats"]
     assert leader["stats"]["verify_passes"] > 0, leader["stats"]
-    assert follower["stopped"] is True and follower["error"] is None
+    for f in followers:
+        assert f["stopped"] is True and f["error"] is None

@@ -17,6 +17,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> None:
@@ -34,38 +35,17 @@ def main() -> None:
     n = len(jax.devices())
     assert n >= 16, f"need 16 virtual devices, got {n}"
 
-    # 70B axis structure at toy width: H=64, KH=8 (GQA 8), head_dim 8,
-    # mlp 1024 (divides 16), 2 layers. Only dims shrink; every sharding
-    # decision (heads/16, kv replicate-vs-shard, mlp/16, vocab fit) is the
-    # real 70B decision.
-    cfg = llama.CONFIGS["llama2-70b"].replace(
-        dim=512, n_layers=2, head_dim=8, hidden_dim=1024,
-        vocab_size=258, max_seq_len=256, dtype=jnp.float32,
-    )
-    assert cfg.n_heads == 64 and cfg.n_kv_heads == 8
+    # ONE definition of the north-star shape (70B axis structure at toy
+    # width, engine knobs, prompt set) shared with the multi-host proof
+    # so the two token-exactness stories can never de-synchronize.
+    from serve_70b_multihost import PROMPTS, engine_config, scaled_70b_cfg
+
+    cfg = scaled_70b_cfg()
     params = llama.init_params(cfg, jax.random.key(0))
     draft_cfg = cfg.replace(n_layers=1)
     draft_params = llama.init_params(draft_cfg, jax.random.key(1))
 
-    def engine_config():
-        return EngineConfig(
-            max_batch=4,
-            max_seq_len=128,
-            # Prompts longer than this exercise chunked prefill.
-            max_prefill_len=32,
-            eos_token_id=257,
-            kv_layout="paged",
-            page_size=16,
-            prefix_cache=True,
-            spec_k=3,
-        )
-
-    prompts = [
-        [256] + list(range(2, 50)),        # 48 tokens -> 2 prefill chunks
-        [256] + list(range(100, 140)),     # 40 tokens
-        [256, 5, 6, 7],                    # short
-        [256] + list(range(2, 50)),        # shared prefix with prompt 0
-    ]
+    prompts = PROMPTS
 
     def run(mesh=None, run_params=params, draft=True):
         eng = Engine(

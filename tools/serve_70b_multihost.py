@@ -1,12 +1,16 @@
 """The north-star topology end-to-end on CPU: multi-host lockstep serving
-of the 70B-structure config over a 16-device tensor=16 mesh spanning TWO
-jax.distributed processes (8 virtual devices each) — the exact shape of
-examples/llama2-70b/server.yaml on a v5e-16 slice (4 hosts x 4 chips;
-two hosts here, same code path: serve/multihost.py lockstep + global-mesh
-GSPMD + int4 weights + paged KV + prompt-lookup speculation).
+of the 70B-structure config over a 16-device tensor=16 mesh spanning
+MULTIPLE jax.distributed processes — tests/test_multihost_70b.py runs it
+as 2 hosts x 8 devices AND as the literal v5e-16 shape, 4 hosts x 4
+chips (examples/llama2-70b/server.yaml; serve/multihost.py lockstep +
+global-mesh GSPMD + int4 weights + paged KV + prompt-lookup speculation).
 
-Worker (launched twice by tests/test_multihost_70b.py):
-    python tools/serve_70b_multihost.py --pid 0 --nprocs 2 \
+Also the single source of the north-star scaled config / engine knobs /
+prompt set — tools/serve_70b_cpu.py imports them, so the single-process
+and multi-host token-exactness proofs can never de-synchronize.
+
+Worker (launched nprocs times by the test):
+    python tools/serve_70b_multihost.py --pid 0 --nprocs 4 \
         --coord 127.0.0.1:9911 --out /tmp/out0.json
 """
 from __future__ import annotations

@@ -26,17 +26,23 @@ def _run_gang(tmp_path, extra=()):
 
 
 def _reference_outs(
-    prompts, spec_k=0, max_seq_len=64, kv_layout="auto", temps=None
+    prompts, spec_k=0, max_seq_len=64, kv_layout="auto", temps=None,
+    draft=False,
 ):
     """Single-process reference generations for gang comparison.
-    temps[i] is each prompt's temperature (default greedy)."""
+    temps[i] is each prompt's temperature (default greedy); draft=True
+    attaches the same 1-layer draft model the gang worker uses."""
     cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
     params = llama.init_params(cfg, jax.random.key(0))
+    dr = None
+    if draft:
+        draft_cfg = cfg.replace(n_layers=1)
+        dr = (draft_cfg, llama.init_params(draft_cfg, jax.random.key(9)))
     ec = EngineConfig(
         max_batch=4, max_seq_len=max_seq_len, eos_token_id=257,
         spec_k=spec_k, kv_layout=kv_layout,
     )
-    engine = Engine(cfg, params, ec)
+    engine = Engine(cfg, params, ec, draft=dr)
     engine.start()
     try:
         return [
@@ -141,3 +147,22 @@ def test_leader_crash_broadcasts_stop(tmp_path):
     # and its own engine saw no error
     assert follower["stopped"] is True
     assert follower["error"] is None
+
+
+def test_two_process_gang_draft_model_speculative(tmp_path):
+    """DRAFT-MODEL speculation under lockstep (the propose scan is a
+    device computation whose proposals every process reads back — the
+    replicated-output constraint in Engine._build_propose is what this
+    exercises cross-process). Low-acceptance worst case (different draft
+    weights) must still be token-exact vs the single-process
+    draft-spec engine."""
+    expected = _reference_outs(
+        [[256, 5, 6, 7], [256, 70, 71]], spec_k=3, draft=True
+    )
+
+    results = _run_gang(tmp_path, extra=("--spec-k", "3", "--draft"))
+    leader = next(r for r in results if r["leader"])
+    follower = next(r for r in results if not r["leader"])
+    assert leader["outs"][:2] == expected, (leader["outs"], expected)
+    assert leader["stats"]["verify_passes"] > 0, leader["stats"]
+    assert follower["stopped"] is True and follower["error"] is None

@@ -35,7 +35,14 @@ def main() -> int:
                     help="poison the leader's decode fn after the first "
                          "generation: its loop must die AND broadcast "
                          "stop so followers exit cleanly")
+    ap.add_argument("--draft", action="store_true",
+                    help="draft-model speculation (1-layer draft of the "
+                         "same config; requires --spec-k)")
     args = ap.parse_args()
+    if args.draft and not args.spec_k:
+        ap.error("--draft requires --spec-k")
+    if args.draft and args.sp:
+        ap.error("--draft needs the paged layout; --sp pins dense")
 
     import jax
 
@@ -70,8 +77,12 @@ def main() -> int:
             max_batch=4, max_seq_len=256 if args.long_prompt else 64,
             eos_token_id=257, spec_k=args.spec_k,
         )
+    draft = None
+    if args.draft:
+        draft_cfg = cfg.replace(n_layers=1)
+        draft = (draft_cfg, llama.init_params(draft_cfg, jax.random.key(9)))
     sync = StepSync()
-    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync)
+    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync, draft=draft)
     engine.start()
 
     result = {"pid": args.pid, "leader": sync.leader}

@@ -3,10 +3,16 @@
 
 PY ?= python
 
-.PHONY: test test-int manifests api-docs protogen nbwatch spm bench graft image install-manifests
+.PHONY: test test-int metrics-lint manifests api-docs protogen nbwatch spm bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# Exposition-format lint for the shared telemetry registry
+# (observability/metrics.py): unique families, HELP/TYPE present, label
+# escaping, histogram +Inf buckets.
+metrics-lint:
+	$(PY) hack/metrics_lint.py
 
 # Controller integration tier only (fake apiserver; reference
 # `make test-integration`).

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Exposition-format lint (make metrics-lint).
+
+Imports every instrumented plane (serve engine, server gauges, train
+telemetry, controller runtime, SCI client) so their metric declarations
+register, synthesizes representative traffic — including label values that
+need escaping — renders the shared registry, and validates the output with
+observability.lint_exposition: unique families, HELP/TYPE before samples,
+parseable samples, escaped labels, +Inf histogram buckets.
+
+Exits non-zero listing each problem. Runs without jax/device access: only
+the declaration modules are imported, nothing jitted.
+"""
+import os
+import sys
+
+sys.dont_write_bytecode = True
+# Runnable from a bare checkout (no pip install -e .): the repo root is
+# this file's parent directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    # Register every plane's declarations (import side effects only).
+    import substratus_tpu.controller.runtime  # noqa: F401
+    import substratus_tpu.sci.client as sci
+    import substratus_tpu.serve.engine  # noqa: F401
+    import substratus_tpu.serve.server  # noqa: F401
+    from substratus_tpu.observability import METRICS, lint_exposition
+
+    # Synthetic traffic across all three kinds, with hostile label values.
+    METRICS.inc("substratus_reconcile_total", {"kind": "Model"})
+    METRICS.inc(
+        "substratus_reconcile_errors_total",
+        {"kind": 'we"ird\\kind\nname'},
+    )
+    METRICS.set("substratus_workqueue_depth", 3)
+    METRICS.observe("substratus_reconcile_seconds", 0.012, {"kind": "Model"})
+    client = sci.FakeSCIClient()
+    client.get_object_md5("gs://bucket", "obj")
+    client.create_signed_url("gs://bucket", "obj", "d41d8cd9")
+    from substratus_tpu.train.telemetry import StepLogger
+
+    StepLogger(
+        n_params=10_000, tokens_per_step=1024, peak_flops=1e12,
+        emit=lambda line: None,
+    ).log_step(0, loss=1.0, step_seconds=0.1, last=True)
+
+    text = METRICS.render()
+    problems = lint_exposition(text)
+    names = [
+        line.split(" ")[2]
+        for line in text.splitlines()
+        if line.startswith("# TYPE ")
+    ]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        problems.append(f"duplicate family declarations: {sorted(dupes)}")
+    if problems:
+        for p in problems:
+            print(f"metrics-lint: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"metrics-lint: ok ({len(names)} families, "
+        f"{len(text.splitlines())} lines)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

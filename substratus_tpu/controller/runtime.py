@@ -25,10 +25,43 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from substratus_tpu.kube.client import Conflict, KubeClient, NotFound, Obj
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.tracing import tracer
 
 log = logging.getLogger("substratus.controller")
 
 CR_KINDS = ("Dataset", "Model", "Notebook", "Server")
+
+# Reconcile instrumentation on the shared registry — the controller-runtime
+# metric names the reference's ServiceMonitor dashboards already query,
+# labeled by CR kind (docs/observability.md).
+METRICS.describe(
+    "substratus_reconcile_total",
+    "Reconcile passes started, by CR kind.", type="counter",
+)
+METRICS.describe(
+    "substratus_reconcile_errors_total",
+    "Reconcile passes that raised (requeued with backoff), by CR kind.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_reconcile_conflicts_total",
+    "Reconcile passes aborted on an optimistic-concurrency conflict.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_workqueue_adds_total",
+    "Items enqueued onto the reconcile workqueue (post-dedup).",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_workqueue_depth",
+    "Reconcile workqueue depth.", type="gauge",
+)
+METRICS.histogram(
+    "substratus_reconcile_seconds",
+    "Wall time of one reconcile pass (all reconcilers for the object).",
+)
 
 
 @dataclass
@@ -62,6 +95,8 @@ class Manager:
             if item not in self._queued:
                 self._queued.add(item)
                 self._queue.append(item)
+                METRICS.inc("substratus_workqueue_adds_total")
+                METRICS.set("substratus_workqueue_depth", len(self._queue))
         self._wake.set()
 
     def _on_event(self, event: str, obj: Obj) -> None:
@@ -132,9 +167,26 @@ class Manager:
 
     def _process(self, item: tuple) -> None:
         kind, ns, name = item
+        METRICS.inc("substratus_reconcile_total", {"kind": kind})
+        t0 = time.perf_counter()
+        with tracer.span(
+            "controller.reconcile", kind=kind, namespace=ns, object=name
+        ) as span:
+            try:
+                self._reconcile(item, span)
+            finally:
+                METRICS.observe(
+                    "substratus_reconcile_seconds",
+                    time.perf_counter() - t0,
+                    {"kind": kind},
+                )
+
+    def _reconcile(self, item: tuple, span) -> None:
+        kind, ns, name = item
         try:
             obj = self.client.get(kind, ns, name)
         except NotFound:
+            span.set_attribute("outcome", "gone")
             return  # deleted; nothing to do (GC is ownerRef-driven)
         for rec in self.reconcilers.get(kind, []):
             try:
@@ -142,16 +194,26 @@ class Manager:
             except Conflict:
                 # Optimistic-concurrency race: someone wrote between our read
                 # and write. Requeue and re-read.
+                METRICS.inc(
+                    "substratus_reconcile_conflicts_total", {"kind": kind}
+                )
+                span.set_attribute("outcome", "conflict")
                 self.enqueue(kind, ns, name)
                 return
             except NotFound:
+                span.set_attribute("outcome", "gone")
                 return
             except Exception:
                 log.exception("reconcile %s %s/%s failed", kind, ns, name)
+                METRICS.inc(
+                    "substratus_reconcile_errors_total", {"kind": kind}
+                )
+                span.set_attribute("outcome", "error")
                 with self._lock:
                     self._delayed.append((time.monotonic() + 5.0, item))
                 return
             if result and result.requeue_after is not None:
+                span.set_attribute("outcome", "requeued")
                 with self._lock:
                     self._delayed.append(
                         (time.monotonic() + result.requeue_after, item)
@@ -162,6 +224,7 @@ class Manager:
             try:
                 obj = self.client.get(kind, ns, name)
             except NotFound:
+                span.set_attribute("outcome", "gone")
                 return
 
     def run_until_idle(self, max_iterations: int = 10_000) -> None:

@@ -1,0 +1,48 @@
+"""Telemetry for every plane: metrics registry, span tracer, health server.
+
+Public API — import from here, not the submodules:
+
+    from substratus_tpu.observability import METRICS, tracer, serve_health
+
+  * ``METRICS`` / ``Metrics`` / ``Histogram`` — process-global Prometheus
+    registry (counters, gauges, fixed-bucket histograms; text format 0.0.4
+    with HELP/TYPE and label escaping);
+  * ``tracer`` / ``Tracer`` / ``SpanContext`` — dependency-free span
+    tracing with contextvar propagation and JSONL export;
+  * ``serve_health`` — /healthz /readyz /metrics HTTP(S) server with
+    optional TokenReview/SubjectAccessReview RBAC (``MetricsAuthorizer``);
+  * ``lint_exposition`` — exposition-format validator (make metrics-lint).
+"""
+from substratus_tpu.observability.metrics import (  # noqa: F401
+    LATENCY_BUCKETS,
+    METRICS,
+    RATIO_BUCKETS,
+    THROUGHPUT_BUCKETS,
+    Histogram,
+    Metrics,
+    escape_label_value,
+    lint_exposition,
+)
+from substratus_tpu.observability.tracing import (  # noqa: F401
+    Span,
+    SpanContext,
+    Tracer,
+    tracer,
+)
+from substratus_tpu.observability.health import serve_health  # noqa: F401
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "METRICS",
+    "RATIO_BUCKETS",
+    "THROUGHPUT_BUCKETS",
+    "Histogram",
+    "Metrics",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "escape_label_value",
+    "lint_exposition",
+    "serve_health",
+    "tracer",
+]

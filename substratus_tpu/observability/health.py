@@ -18,34 +18,9 @@ import tempfile
 import threading
 from typing import Optional
 
-
-class Metrics:
-    """Process-global counters, exposed in Prometheus text format."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.counters = {}
-
-    def inc(self, name: str, labels: str = "", by: float = 1.0) -> None:
-        with self._lock:
-            key = (name, labels)
-            self.counters[key] = self.counters.get(key, 0.0) + by
-
-    def set(self, name: str, value: float, labels: str = "") -> None:
-        with self._lock:
-            self.counters[(name, labels)] = value
-
-    def render(self) -> str:
-        with self._lock:
-            lines = []
-            for (name, labels), value in sorted(self.counters.items()):
-                lines.append(
-                    f"{name}{{{labels}}} {value}" if labels else f"{name} {value}"
-                )
-            return "\n".join(lines) + "\n"
-
-
-METRICS = Metrics()
+# The registry moved to observability/metrics.py (HELP/TYPE exposition,
+# label escaping, histograms); re-exported here for existing deep imports.
+from substratus_tpu.observability.metrics import METRICS, Metrics  # noqa: F401
 
 
 def serve_health(

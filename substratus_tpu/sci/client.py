@@ -13,9 +13,56 @@ call an SCI client. Implementations:
 """
 from __future__ import annotations
 
+import functools
+import time
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from typing import Optional
+
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.tracing import tracer
+
+METRICS.histogram(
+    "substratus_sci_request_seconds",
+    "SCI client call latency by RPC method (seconds).",
+)
+METRICS.describe(
+    "substratus_sci_errors_total",
+    "SCI client calls that raised, by RPC method.", type="counter",
+)
+
+
+def traced(method: str):
+    """Instrument an SCI client call: a `sci.<method>` span (joining the
+    caller's trace — reconcile spans show their cloud round-trips) plus the
+    shared latency histogram and error counter. Decorates every
+    implementation, so controller tests against FakeSCIClient exercise the
+    same telemetry path production GrpcSCIClient traffic does."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                with tracer.span(
+                    f"sci.{method}", client=type(self).__name__
+                ):
+                    return fn(self, *args, **kwargs)
+            except Exception:
+                METRICS.inc(
+                    "substratus_sci_errors_total", {"method": method}
+                )
+                raise
+            finally:
+                METRICS.observe(
+                    "substratus_sci_request_seconds",
+                    time.perf_counter() - t0,
+                    {"method": method},
+                )
+
+        return wrapper
+
+    return deco
 
 
 @dataclass
@@ -45,6 +92,7 @@ class FakeSCIClient(SCIClient):
         self.bound = []  # (principal, namespace, name)
         self.md5s = {}  # object_path -> md5
 
+    @traced("CreateSignedURL")
     def create_signed_url(self, bucket_url, object_path, md5_checksum,
                           expiration_seconds=300) -> SignedURL:
         return SignedURL(
@@ -52,8 +100,10 @@ class FakeSCIClient(SCIClient):
             expiration_seconds=expiration_seconds,
         )
 
+    @traced("GetObjectMd5")
     def get_object_md5(self, bucket_url, object_path) -> Optional[str]:
         return self.md5s.get(object_path)
 
+    @traced("BindIdentity")
     def bind_identity(self, principal, namespace, name) -> None:
         self.bound.append((principal, namespace, name))

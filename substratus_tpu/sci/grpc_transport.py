@@ -16,7 +16,7 @@ import grpc
 
 from substratus_tpu.sci import sci_pb2 as pb
 from substratus_tpu.sci.backends import SCIBackend
-from substratus_tpu.sci.client import SCIClient, SignedURL
+from substratus_tpu.sci.client import SCIClient, SignedURL, traced
 
 SERVICE = "sci.v1.Controller"
 
@@ -45,6 +45,7 @@ class GrpcSCIClient(SCIClient):
             response_deserializer=pb.BindIdentityResponse.FromString,
         )
 
+    @traced("CreateSignedURL")
     def create_signed_url(self, bucket_url, object_path, md5_checksum,
                           expiration_seconds=300) -> SignedURL:
         resp = self._signed_url(
@@ -57,6 +58,7 @@ class GrpcSCIClient(SCIClient):
         )
         return SignedURL(url=resp.url, expiration_seconds=expiration_seconds)
 
+    @traced("GetObjectMd5")
     def get_object_md5(self, bucket_url, object_path) -> Optional[str]:
         resp = self._md5(
             pb.GetObjectMd5Request(
@@ -65,6 +67,7 @@ class GrpcSCIClient(SCIClient):
         )
         return resp.md5_checksum if resp.exists else None
 
+    @traced("BindIdentity")
     def bind_identity(self, principal, namespace, name) -> None:
         self._bind(
             pb.BindIdentityRequest(

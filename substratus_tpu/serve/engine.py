@@ -32,7 +32,36 @@ import numpy as np
 
 from substratus_tpu.models import llama
 from substratus_tpu.models.llama import LlamaConfig, Params
+from substratus_tpu.observability.metrics import METRICS, RATIO_BUCKETS
+from substratus_tpu.observability.tracing import SpanContext, tracer
 from substratus_tpu.ops.sampling import sample
+
+# Serving latency/utilization histograms (docs/observability.md). Declared
+# once at import so /metrics carries the HELP/TYPE headers even before the
+# first request arrives.
+METRICS.histogram(
+    "substratus_serve_ttft_seconds",
+    "Time from request submission to its first generated token (seconds).",
+)
+METRICS.histogram(
+    "substratus_serve_inter_token_seconds",
+    "Gap between consecutive generated tokens of one request (seconds).",
+)
+METRICS.histogram(
+    "substratus_serve_queue_wait_seconds",
+    "Time from request submission to the start of its prefill (seconds).",
+)
+METRICS.histogram(
+    "substratus_serve_batch_occupancy_ratio",
+    "Active decode slots / max_batch, sampled once per scheduler iteration.",
+    buckets=RATIO_BUCKETS,
+)
+METRICS.histogram(
+    "substratus_serve_kv_page_utilization_ratio",
+    "Allocated KV pages / pool size, sampled once per scheduler iteration "
+    "(paged layout only).",
+    buckets=RATIO_BUCKETS,
+)
 
 
 @dataclass
@@ -98,6 +127,14 @@ class Request:
     # processes.
     cancel_latched: bool = False
     sync_id: Optional[int] = None
+    # Telemetry (set by submit()/the scheduler): submission timestamp for
+    # queue-wait/TTFT, previous-emit timestamp for inter-token latency, and
+    # the submitter's span context so engine-side spans join the request's
+    # trace. Followers in lockstep mode leave submit_ts at 0 (the wall
+    # clocks aren't comparable across hosts) — their observations skip.
+    submit_ts: float = 0.0
+    last_emit_ts: float = 0.0
+    trace_ctx: Optional[SpanContext] = None
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -561,6 +598,9 @@ class Engine:
             req.finish_reason = "error"
             req.out.put(None)  # engine is dead; never strand the caller
             return req
+        req.submit_ts = time.perf_counter()
+        if req.trace_ctx is None:
+            req.trace_ctx = tracer.current_context()
         self.queue.put(req)
         if self.error is not None:
             # The scheduler may have died between the check above and the
@@ -687,10 +727,22 @@ class Engine:
                 break
             self._admitting = req
             slot = int(np.flatnonzero(~self.active)[0])
-            if self.paged:
-                ok = self._admit_paged(req, slot)
-            else:
-                ok = self._admit_dense(req, slot)
+            # Queue wait is submission -> first prefill; a preempted
+            # request re-boarding (last_emit_ts set) already paid it.
+            if req.submit_ts and not req.last_emit_ts:
+                METRICS.observe(
+                    "substratus_serve_queue_wait_seconds",
+                    time.perf_counter() - req.submit_ts,
+                )
+            with tracer.span(
+                "engine.prefill", parent=req.trace_ctx,
+                request_id=req.id, slot=slot,
+                prompt_tokens=len(req.prompt_tokens),
+            ):
+                if self.paged:
+                    ok = self._admit_paged(req, slot)
+                else:
+                    ok = self._admit_dense(req, slot)
             self._admitting = None
             if not ok:
                 # Pool dry even after eviction: hold the request at the
@@ -1111,6 +1163,17 @@ class Engine:
         hit_window = int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
         cancelled = self._is_cancelled(req)
         if not hit_eos and not cancelled:
+            now = time.perf_counter()
+            if req.last_emit_ts:
+                METRICS.observe(
+                    "substratus_serve_inter_token_seconds",
+                    now - req.last_emit_ts,
+                )
+            elif req.submit_ts:
+                METRICS.observe(
+                    "substratus_serve_ttft_seconds", now - req.submit_ts
+                )
+            req.last_emit_ts = now
             req.out.put(token_id)
             self.slot_tokens[slot].append(token_id)
         if hit_eos or hit_budget or hit_window or cancelled:
@@ -1133,6 +1196,15 @@ class Engine:
                     # idle gangs tick slower (<=20ms first-token cost).
                     time.sleep(0.02 if self.sync is not None else 0.002)
                     continue
+                METRICS.observe(
+                    "substratus_serve_batch_occupancy_ratio",
+                    float(self.active.sum()) / self.ec.max_batch,
+                )
+                if self.paged:
+                    METRICS.observe(
+                        "substratus_serve_kv_page_utilization_ratio",
+                        (self.n_pages - self.alloc.free_pages) / self.n_pages,
+                    )
                 if self.spec:
                     self._spec_step()
                 else:

@@ -22,8 +22,25 @@ from typing import Optional
 
 from aiohttp import web
 
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.tracing import tracer
 from substratus_tpu.serve.engine import Engine, Request
 from substratus_tpu.serve.tokenizer import Tokenizer
+
+# Scrape-time engine gauges (request-latency histograms live in
+# serve/engine.py; the full catalog is docs/observability.md).
+for _name, _help in (
+    ("substratus_serve_active_slots", "Decode slots currently generating."),
+    ("substratus_serve_max_slots", "Configured decode slot count (max_batch)."),
+    ("substratus_serve_queue_depth", "Requests waiting for a decode slot."),
+    ("substratus_serve_kv_pages_total", "KV pool size in pages (paged layout)."),
+    ("substratus_serve_kv_pages_free", "Unallocated KV pages (paged layout)."),
+):
+    METRICS.describe(_name, _help, type="gauge")
+METRICS.describe(
+    "substratus_serve_requests_total",
+    "Completion requests received.", type="counter",
+)
 
 
 class ServerState:
@@ -196,25 +213,25 @@ def build_app(state: ServerState) -> web.Application:
 
     @routes.get("/metrics")
     async def metrics(request: web.Request) -> web.Response:
-        """Prometheus-format serving metrics."""
+        """Prometheus-format serving metrics: point-in-time engine gauges
+        refreshed at scrape, plus everything already in the shared registry
+        (latency histograms from serve/engine.py, reconcile counters when a
+        controller shares the process). One registry, one exposition."""
         eng = state.engine
-        active = int(eng.active.sum())
-        lines = [
-            f"substratus_serve_active_slots {active}",
-            f"substratus_serve_max_slots {eng.ec.max_batch}",
-            f"substratus_serve_queue_depth {eng.queue.qsize()}",
-        ]
-        lines += [
-            f"substratus_serve_{k} {v}" for k, v in sorted(eng.stats.items())
-        ]
+        METRICS.set("substratus_serve_active_slots", int(eng.active.sum()))
+        METRICS.set("substratus_serve_max_slots", eng.ec.max_batch)
+        METRICS.set("substratus_serve_queue_depth", eng.queue.qsize())
+        for k, v in eng.stats.items():
+            METRICS.set(f"substratus_serve_{k}", v)
         if getattr(eng, "paged", False):
-            lines += [
-                f"substratus_serve_kv_pages_total {eng.n_pages}",
-                f"substratus_serve_kv_pages_free {eng.alloc.free_pages}",
-            ]
+            METRICS.set("substratus_serve_kv_pages_total", eng.n_pages)
+            METRICS.set("substratus_serve_kv_pages_free", eng.alloc.free_pages)
+        # The versioned content type Prometheus negotiates for (the
+        # controller endpoint in observability/health.py already sends it;
+        # a bare text/plain leaves the scraper guessing the format version).
         return web.Response(
-            text="\n".join(lines) + "\n",
-            content_type="text/plain",
+            body=METRICS.render().encode(),
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
         )
 
     @routes.get("/v1/models")
@@ -422,11 +439,19 @@ def build_app(state: ServerState) -> web.Application:
         _validate_body(body)
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
-        if body.get("stream"):
-            return await _stream(request, str(prompt), body, chat=False)
-        text, n_prompt, n_gen, finish = await _generate(
-            request, str(prompt), body
-        )
+        METRICS.inc("substratus_serve_requests_total")
+        with tracer.span(
+            "serve.completion", endpoint="/v1/completions",
+            stream=bool(body.get("stream")),
+        ) as span:
+            if body.get("stream"):
+                return await _stream(request, str(prompt), body, chat=False)
+            text, n_prompt, n_gen, finish = await _generate(
+                request, str(prompt), body
+            )
+            span.set_attribute("prompt_tokens", n_prompt)
+            span.set_attribute("completion_tokens", n_gen)
+            span.set_attribute("finish_reason", finish)
         return web.json_response(
             _completion_body(state, text, n_prompt, n_gen, finish)
         )
@@ -440,13 +465,18 @@ def build_app(state: ServerState) -> web.Application:
         _validate_body(body)
         messages = body.get("messages") or []
         prompt, templated = state.render_chat(messages)
-        if body.get("stream"):
-            return await _stream(
-                request, prompt, body, chat=True, templated=templated
+        METRICS.inc("substratus_serve_requests_total")
+        with tracer.span(
+            "serve.completion", endpoint="/v1/chat/completions",
+            stream=bool(body.get("stream")), messages=len(messages),
+        ):
+            if body.get("stream"):
+                return await _stream(
+                    request, prompt, body, chat=True, templated=templated
+                )
+            text, n_prompt, n_gen, finish = await _generate(
+                request, prompt, body, templated
             )
-        text, n_prompt, n_gen, finish = await _generate(
-            request, prompt, body, templated
-        )
         resp = _completion_body(state, text, n_prompt, n_gen, finish)
         resp["object"] = "chat.completion"
         resp["choices"] = [

@@ -66,6 +66,7 @@ def main(argv=None) -> int:
     )
     from substratus_tpu.train.data import PackedDataset
     from substratus_tpu.train.lora import merge_lora
+    from substratus_tpu.train.telemetry import StepLogger, device_peak_flops
     from substratus_tpu.train.trainer import TrainConfig, Trainer
 
     steps = int(p.get("steps", p.get("max_steps", 100)))
@@ -213,19 +214,32 @@ def main(argv=None) -> int:
     elif prof:
         print(f"ignoring malformed profile_steps {prof!r} (need [start, end])")
 
+    # Structured per-step telemetry (train/telemetry.py): step-time and
+    # tokens/sec histograms + MFU on the shared registry, one JSON line per
+    # log interval instead of bare prints. tokens_per_step is the GLOBAL
+    # batch; train_step blocks on the loss, so the measured wall time is
+    # the device step, not just dispatch.
+    step_log = StepLogger(
+        n_params=sum(
+            getattr(x, "size", 0) for x in jax.tree.leaves(trainer.params)
+        ),
+        tokens_per_step=batch_size * seq_len,
+        peak_flops=device_peak_flops(),
+    )
     tracing = False
-    t0 = time.time()
     for step in range(start_step, steps):
         if prof_range and step == prof_range[0]:
             jax.profiler.start_trace(os.path.join(args.out, "profile"))
             tracing = True
+        t_step = time.perf_counter()
         loss = trainer.train_step(next(data))
+        step_log.log_step(
+            step, float(loss), time.perf_counter() - t_step,
+            last=step == steps - 1,
+        )
         if tracing and step == prof_range[1]:
             jax.profiler.stop_trace()
             tracing = False
-        if step % 10 == 0 or step == steps - 1:
-            dt = time.time() - t0
-            print(f"step {step} loss {loss:.4f} ({dt:.1f}s)", flush=True)
         trainable = trainer.lora if trainer.lora is not None else trainer.params
         ckpt.maybe_save(
             step + 1,

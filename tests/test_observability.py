@@ -1,0 +1,360 @@
+"""Unified telemetry subsystem tests: registry exposition format, histogram
+math under concurrency, tracer nesting/propagation, and the end-to-end
+serve-scrape + train-step acceptance paths (ISSUE 1)."""
+import json
+import re
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from substratus_tpu.observability import (
+    METRICS,
+    Metrics,
+    Tracer,
+    lint_exposition,
+    tracer,
+)
+
+
+# --- registry / exposition format -----------------------------------------
+
+def test_render_help_type_and_escaping():
+    m = Metrics()
+    m.describe("jobs_total", "Jobs processed.", type="counter")
+    m.inc("jobs_total", {"path": 'a\\b"c\nd'})
+    m.set("temp_celsius", 21.5)
+    out = m.render()
+    assert "# HELP jobs_total Jobs processed.\n# TYPE jobs_total counter" in out
+    assert "# TYPE temp_celsius gauge" in out
+    # backslash, quote, newline escaped per the exposition spec
+    assert 'jobs_total{path="a\\\\b\\"c\\nd"} 1' in out
+    assert lint_exposition(out) == []
+
+
+def test_integer_samples_render_without_dot_zero():
+    m = Metrics()
+    m.set("slots", 4.0)  # float in, canonical int out
+    m.inc("reqs_total", by=2.0)
+    assert "slots 4\n" in m.render()
+    assert "reqs_total 2\n" in m.render()
+    m.set("slots", 4)  # int in: same rendering, no scrape-to-scrape drift
+    assert "slots 4\n" in m.render()
+    m.set("frac", 0.25)
+    assert "frac 0.25" in m.render()
+
+
+def test_type_conflicts_and_bad_names_rejected():
+    m = Metrics()
+    m.inc("a_total")
+    with pytest.raises(ValueError):
+        m.set("a_total", 1)  # counter can't become a gauge
+    with pytest.raises(ValueError):
+        m.inc("bad-name")
+    with pytest.raises(ValueError):
+        m.inc("ok_name", {"bad-label": 1})
+
+
+def test_histogram_bucket_sum_count_math():
+    m = Metrics()
+    m.observe("lat", 0.5, buckets=(1.0, 2.0))
+    m.observe("lat", 1.5, buckets=(1.0, 2.0))
+    m.observe("lat", 99.0, buckets=(1.0, 2.0))
+    out = m.render()
+    assert 'lat_bucket{le="1"} 1' in out
+    assert 'lat_bucket{le="2"} 2' in out  # cumulative
+    assert 'lat_bucket{le="+Inf"} 3' in out
+    assert "lat_sum 101" in out
+    assert "lat_count 3" in out
+    assert lint_exposition(out) == []
+
+
+def test_histogram_concurrent_observe():
+    m = Metrics()
+    h = m.histogram("work_seconds", "t", buckets=(0.5, 1.0, 5.0))
+    n_threads, per_thread = 8, 500
+
+    def work(i):
+        for j in range(per_thread):
+            h.observe(0.25 if (i + j) % 2 else 2.0)
+
+    threads = [
+        threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    out = m.render()
+    assert f"work_seconds_count {total}" in out
+    # every observation landed in exactly one bucket, no lost updates
+    assert f'work_seconds_bucket{{le="0.5"}} {total // 2}' in out
+    assert f'work_seconds_bucket{{le="+Inf"}} {total}' in out
+    # integer-valued sum renders canonically (no .0)
+    assert f"work_seconds_sum {int((0.25 + 2.0) * (total // 2))}" in out
+
+
+# --- tracer ----------------------------------------------------------------
+
+def test_span_nesting_same_trace():
+    tr = Tracer()
+    with tr.span("outer", kind="test") as outer:
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = tr.finished()
+    assert [s["name"] for s in spans] == ["inner", "outer"]  # end order
+    assert spans[0]["parent_id"] == spans[1]["span_id"]
+    assert spans[1]["parent_id"] is None
+    assert spans[1]["attributes"]["kind"] == "test"
+    assert all(s["status"] == "ok" for s in spans)
+
+
+def test_span_error_status_propagates():
+    tr = Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    assert tr.finished()[0]["status"] == "error:RuntimeError"
+
+
+def test_contextvar_propagation_across_threads():
+    tr = Tracer()
+    with tr.span("request") as root:
+        ctx = tr.current_context()
+
+        def engine_side():
+            # explicit parent: contextvars don't cross threads
+            with tr.span("engine.work", parent=ctx):
+                pass
+
+        def unrelated():
+            with tr.span("background"):
+                pass
+
+        t1 = threading.Thread(target=engine_side)
+        t2 = threading.Thread(target=unrelated)
+        t1.start(); t2.start(); t1.join(); t2.join()
+    by_name = {s["name"]: s for s in tr.finished()}
+    assert by_name["engine.work"]["trace_id"] == root.trace_id
+    assert by_name["engine.work"]["parent_id"] == root.span_id
+    # a thread with no parent starts its own trace, not the request's
+    assert by_name["background"]["trace_id"] != root.trace_id
+    assert by_name["background"]["parent_id"] is None
+
+
+def test_ring_buffer_bound_and_jsonl_export(tmp_path):
+    tr = Tracer(capacity=8)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.finished()) == 8
+    assert tr.dropped == 12
+    assert tr.finished()[0]["name"] == "s12"  # oldest evicted first
+    path = tmp_path / "traces" / "spans.jsonl"
+    assert tr.export_jsonl(str(path)) == 8
+    lines = path.read_text().splitlines()
+    assert len(lines) == 8
+    for line in lines:
+        rec = json.loads(line)
+        assert set(rec) == {
+            "trace_id", "span_id", "parent_id", "name", "start_us",
+            "duration_us", "attributes", "status",
+        }
+    assert tr.finished() == []  # drained on successful export
+
+
+# --- controller + SCI planes on the shared registry ------------------------
+
+def test_reconcile_counters_and_spans_on_shared_registry():
+    from substratus_tpu.controller.runtime import Manager, Result
+    from substratus_tpu.kube.fake import FakeKube
+    from substratus_tpu.sci.client import FakeSCIClient
+
+    kube = FakeKube()
+    mgr = Manager(kube)
+    sci = FakeSCIClient()
+    seen = []
+
+    def reconcile(obj):
+        sci.get_object_md5("bucket", obj["metadata"]["name"])
+        seen.append(obj["metadata"]["name"])
+        return Result()
+
+    mgr.register("Model", reconcile)
+    before = METRICS.get("substratus_reconcile_total", {"kind": "Model"}) or 0
+    tracer.clear()
+    kube.create({
+        "apiVersion": "substratus.ai/v1", "kind": "Model",
+        "metadata": {"name": "m1", "namespace": "default"}, "spec": {},
+    })
+    mgr.run_until_idle()
+    assert seen == ["m1"]
+    after = METRICS.get("substratus_reconcile_total", {"kind": "Model"})
+    assert after == before + 1
+    assert (
+        METRICS.get("substratus_reconcile_seconds", {"kind": "Model"}) or 0
+    ) >= 1
+    names = [s["name"] for s in tracer.finished()]
+    assert "controller.reconcile" in names
+    assert "sci.GetObjectMd5" in names
+    # the SCI call ran inside the reconcile span -> same trace
+    rec = next(
+        s for s in tracer.finished() if s["name"] == "controller.reconcile"
+    )
+    sci_span = next(
+        s for s in tracer.finished() if s["name"] == "sci.GetObjectMd5"
+    )
+    assert sci_span["trace_id"] == rec["trace_id"]
+    assert sci_span["parent_id"] == rec["span_id"]
+    out = METRICS.render()
+    assert lint_exposition(out) == [], lint_exposition(out)
+
+
+# --- serve + train acceptance paths ----------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params,
+        EngineConfig(max_batch=4, max_seq_len=64, eos_token_id=257),
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _series_value(text: str, name: str, labels_re: str = "") -> float:
+    m = re.search(
+        rf"^{re.escape(name)}{labels_re} ([0-9.e+-]+|\+Inf|NaN)$",
+        text, re.M,
+    )
+    assert m, f"{name} not found in exposition"
+    return float(m.group(1))
+
+
+def test_serve_metrics_end_to_end_scrape(engine):
+    """A real engine request populates the TTFT / inter-token histograms,
+    and GET /metrics serves the whole registry in parseable 0.0.4 format
+    with the versioned content type (acceptance criterion)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.serve.server import ServerState, build_app
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    state = ServerState(engine, ByteTokenizer(), "tiny")
+
+    async def go():
+        app = build_app(state)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hi", "max_tokens": 8,
+                      "temperature": 0.0},
+            )
+            assert r.status == 200
+            n_gen = (await r.json())["usage"]["completion_tokens"]
+            assert n_gen >= 2  # inter-token latency needs a second token
+            r = await client.get("/metrics")
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+            return await r.text()
+
+    text = asyncio.run(go())
+    assert lint_exposition(text) == [], lint_exposition(text)
+    # histogram triplets exist and were populated by the request
+    for fam in (
+        "substratus_serve_ttft_seconds",
+        "substratus_serve_inter_token_seconds",
+        "substratus_serve_queue_wait_seconds",
+        "substratus_serve_batch_occupancy_ratio",
+    ):
+        assert f"# TYPE {fam} histogram" in text
+        assert f'{fam}_bucket{{le="+Inf"}}' in text
+        assert _series_value(text, f"{fam}_count") >= 1
+        assert _series_value(text, f"{fam}_sum") >= 0
+    assert _series_value(text, "substratus_serve_ttft_seconds_count") >= 1
+    assert (
+        _series_value(text, "substratus_serve_inter_token_seconds_count")
+        >= 1
+    )
+    # legacy engine gauges still scrape, integer-rendered
+    assert "substratus_serve_max_slots 4\n" in text
+    assert _series_value(text, "substratus_serve_requests_total") >= 1
+    # request handling produced a trace with engine-side children
+    names = [s["name"] for s in tracer.finished()]
+    assert "serve.completion" in names
+    assert "engine.prefill" in names
+    req_span = next(
+        s for s in reversed(tracer.finished())
+        if s["name"] == "serve.completion"
+    )
+    prefill = next(
+        s for s in reversed(tracer.finished())
+        if s["name"] == "engine.prefill"
+    )
+    assert prefill["trace_id"] == req_span["trace_id"]
+
+
+def test_train_step_telemetry_smoke():
+    """The structured log_step path records step-time observations through
+    the SHARED registry (acceptance criterion) and emits JSON lines."""
+    from substratus_tpu.train.telemetry import StepLogger
+
+    before = METRICS.get("substratus_train_step_seconds") or 0
+    lines = []
+    sl = StepLogger(
+        n_params=1_000_000, tokens_per_step=4096,
+        peak_flops=197e12, log_every=10, emit=lines.append,
+    )
+    for step in range(3):
+        sl.log_step(step, loss=2.5 - step * 0.1, step_seconds=0.05,
+                    last=step == 2)
+    after = METRICS.get("substratus_train_step_seconds")
+    assert after == before + 3
+    assert len(lines) == 2  # step 0 (interval) + step 2 (last)
+    rec = json.loads(lines[-1])
+    assert rec["event"] == "train_step"
+    assert rec["step"] == 2
+    assert rec["tokens_per_second"] == pytest.approx(4096 / 0.05, rel=0.01)
+    assert rec["mfu"] > 0
+    out = METRICS.render()
+    assert "# TYPE substratus_train_step_seconds histogram" in out
+    assert "substratus_train_tokens_per_second_count" in out
+    assert lint_exposition(out) == [], lint_exposition(out)
+
+
+def test_health_server_serves_shared_registry():
+    """The controller-side health endpoint renders the same registry with
+    HELP/TYPE headers (it used to emit bare name/value lines)."""
+    import urllib.request
+
+    from substratus_tpu.observability import serve_health
+
+    METRICS.set("substratus_probe_check", 1)
+    server = serve_health(port=0)
+    port = server.socket.getsockname()[1]
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            assert r.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+            body = r.read().decode()
+    finally:
+        server.shutdown()
+    assert "# TYPE substratus_probe_check gauge" in body
+    assert lint_exposition(body) == [], lint_exposition(body)

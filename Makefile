@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int metrics-lint manifests api-docs protogen nbwatch spm bench graft image install-manifests
+.PHONY: test test-int metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -13,6 +13,12 @@ test:
 # escaping, histogram +Inf buckets.
 metrics-lint:
 	$(PY) hack/metrics_lint.py
+
+# Span-export lint (observability/tracing.py JSONL contract): id widths,
+# parent referential integrity within a trace, non-negative durations.
+# `make trace-lint FILES=path.jsonl` lints a real export instead.
+trace-lint:
+	$(PY) hack/trace_lint.py $(FILES)
 
 # Controller integration tier only (fake apiserver; reference
 # `make test-integration`).

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Span-export lint (make trace-lint).
+
+The tracer's JSONL export (observability/tracing.py) is the contract every
+downstream consumer — /debug/tracez, the troubleshooting recipe's jq
+queries, a future OTLP converter — parses. This lint pins it: it exercises
+the tracer the way production code does (nested spans, a thread hop with
+an explicit parent, an error span, a remote W3C parent parsed from a
+traceparent header), exports to a real file, re-reads it, and validates
+every record:
+
+  * required keys exactly: trace_id/span_id/parent_id/name/start_us/
+    duration_us/attributes/status;
+  * id widths: trace_id 32 lowercase hex, span_id 16, parent_id 16 or
+    null;
+  * non-negative integer start/duration;
+  * parent referential integrity: a parent_id PRESENT in the export must
+    belong to the same trace, never be the span itself, and never form a
+    cycle. Absent parents are legal — they are remote callers (W3C
+    traceparent) or ring-evicted ancestors;
+  * span_id uniqueness across the export.
+
+Also self-checks that deliberately broken records are caught (a validator
+that accepts garbage lints nothing). Runs without jax/device access. With
+file arguments, lints those JSONL exports instead of the synthetic ones.
+"""
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+
+sys.dont_write_bytecode = True
+# Runnable from a bare checkout (no pip install -e .): the repo root is
+# this file's parent directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HEX32 = re.compile(r"^[0-9a-f]{32}$")
+_HEX16 = re.compile(r"^[0-9a-f]{16}$")
+_REQUIRED_KEYS = {
+    "trace_id", "span_id", "parent_id", "name", "start_us", "duration_us",
+    "attributes", "status",
+}
+
+
+def lint_spans(records) -> list:
+    """Validate decoded span records; returns a list of problem strings
+    (empty = clean)."""
+    problems = []
+    by_id = {}
+    for i, rec in enumerate(records):
+        where = f"span[{i}]"
+        if not isinstance(rec, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        missing = _REQUIRED_KEYS - set(rec)
+        extra = set(rec) - _REQUIRED_KEYS
+        if missing:
+            problems.append(f"{where}: missing keys {sorted(missing)}")
+            continue
+        if extra:
+            problems.append(f"{where}: unexpected keys {sorted(extra)}")
+        if not isinstance(rec["trace_id"], str) or not _HEX32.match(
+            rec["trace_id"]
+        ):
+            problems.append(
+                f"{where}: trace_id {rec['trace_id']!r} is not 32-hex"
+            )
+        if not isinstance(rec["span_id"], str) or not _HEX16.match(
+            rec["span_id"]
+        ):
+            problems.append(
+                f"{where}: span_id {rec['span_id']!r} is not 16-hex"
+            )
+        pid = rec["parent_id"]
+        if pid is not None and (
+            not isinstance(pid, str) or not _HEX16.match(pid)
+        ):
+            problems.append(f"{where}: parent_id {pid!r} is not 16-hex/null")
+        for key in ("start_us", "duration_us"):
+            v = rec[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(
+                    f"{where}: {key} {v!r} is not a non-negative integer"
+                )
+        if not isinstance(rec["name"], str) or not rec["name"]:
+            problems.append(f"{where}: empty/non-string name")
+        if not isinstance(rec["attributes"], dict):
+            problems.append(f"{where}: attributes is not an object")
+        status = rec["status"]
+        if not isinstance(status, str) or not (
+            status == "ok" or status.startswith("error:")
+        ):
+            problems.append(f"{where}: status {status!r} invalid")
+        sid = rec.get("span_id")
+        if isinstance(sid, str):
+            if sid in by_id:
+                problems.append(f"{where}: duplicate span_id {sid}")
+            else:
+                by_id[sid] = rec
+
+    # Parent referential integrity WITHIN the export: an in-file parent
+    # must share the trace; absent parents are remote/evicted and legal.
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        pid = rec.get("parent_id")
+        sid = rec.get("span_id")
+        if pid is None or not isinstance(pid, str):
+            continue
+        if pid == sid:
+            problems.append(f"span {sid}: is its own parent")
+            continue
+        parent = by_id.get(pid)
+        if parent is not None and parent.get("trace_id") != rec.get(
+            "trace_id"
+        ):
+            problems.append(
+                f"span {sid}: parent {pid} belongs to trace "
+                f"{parent.get('trace_id')}, not {rec.get('trace_id')}"
+            )
+        # Cycle walk over in-file ancestry.
+        seen = set()
+        cur = rec
+        while cur is not None:
+            csid = cur.get("span_id")
+            if csid in seen:
+                problems.append(f"span {sid}: parent cycle through {csid}")
+                break
+            seen.add(csid)
+            cpid = cur.get("parent_id")
+            cur = by_id.get(cpid) if isinstance(cpid, str) else None
+    return problems
+
+
+def lint_jsonl(text: str) -> list:
+    records, problems = [], []
+    for n, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError as e:
+            problems.append(f"line {n}: not valid JSON ({e})")
+    return problems + lint_spans(records)
+
+
+def _synthesize() -> str:
+    """Exercise the tracer like production code and return the JSONL."""
+    from substratus_tpu.observability.propagation import parse_traceparent
+    from substratus_tpu.observability.tracing import Tracer
+
+    tr = Tracer()
+    # Remote parent: a CLI-injected traceparent adopted by the server.
+    remote = parse_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    with tr.span("serve.http", parent=remote, path="/v1/completions"):
+        with tr.span("serve.completion", endpoint="/v1/completions") as c:
+            ctx = c.context()
+
+            def engine_side():
+                # Thread hop: explicit parent, contextvar not consulted.
+                with tr.span("engine.prefill", parent=ctx, slot=0):
+                    pass
+
+            t = threading.Thread(target=engine_side)
+            t.start()
+            t.join()
+    try:
+        with tr.span("controller.reconcile", kind="Model"):
+            raise RuntimeError("synthetic")
+    except RuntimeError:
+        pass
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "spans.jsonl")
+        tr.export_jsonl(path)
+        with open(path) as f:
+            return f.read()
+
+
+def _self_check() -> list:
+    """The validator must reject broken records."""
+    good = json.loads(_synthesize().splitlines()[0])
+    failures = []
+    cases = {
+        "short trace_id": {**good, "trace_id": "abc"},
+        "uppercase span_id": {**good, "span_id": "ABCDEF0123456789"},
+        "negative duration": {**good, "duration_us": -1},
+        "self parent": {**good, "parent_id": good["span_id"]},
+        "missing key": {
+            k: v for k, v in good.items() if k != "status"
+        },
+    }
+    for label, rec in cases.items():
+        if not lint_spans([rec]):
+            failures.append(f"self-check: {label} not detected")
+    # Cross-trace parent needs two records.
+    other = {
+        **good,
+        "trace_id": "ef" * 16,
+        "span_id": "12" * 8,
+        "parent_id": good["span_id"],
+    }
+    if not lint_spans([good, other]):
+        failures.append("self-check: cross-trace parent not detected")
+    return failures
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        problems = []
+        n = 0
+        for path in argv:
+            with open(path) as f:
+                text = f.read()
+            n += len(text.splitlines())
+            problems += [f"{path}: {p}" for p in lint_jsonl(text)]
+    else:
+        text = _synthesize()
+        n = len(text.splitlines())
+        problems = lint_jsonl(text) + _self_check()
+    if problems:
+        for p in problems:
+            print(f"trace-lint: {p}", file=sys.stderr)
+        return 1
+    print(f"trace-lint: ok ({n} spans)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

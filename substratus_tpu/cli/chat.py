@@ -41,7 +41,17 @@ def stream_chat(
     temperature: float = 0.7,
     timeout: float = 300.0,
 ):
-    """POST /v1/chat/completions stream=true; yields content deltas."""
+    """POST /v1/chat/completions stream=true; yields content deltas.
+
+    Each request runs inside a `cli.chat_request` span whose W3C
+    traceparent rides the request headers — the server adopts it, so the
+    CLI, HTTP, and engine spans of one turn share one trace id end to end
+    (docs/observability.md "Distributed tracing")."""
+    from substratus_tpu.observability.propagation import (
+        format_traceparent, inject_headers,
+    )
+    from substratus_tpu.observability.tracing import tracer
+
     body = json.dumps(
         {
             "messages": messages,
@@ -50,27 +60,35 @@ def stream_chat(
             "stream": True,
         }
     ).encode()
-    req = urllib.request.Request(
-        url.rstrip("/") + "/v1/chat/completions",
-        data=body,
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        for raw in resp:
-            line = raw.decode("utf-8", "replace").strip()
-            if not line.startswith("data:"):
-                continue
-            payload = line[len("data:"):].strip()
-            if payload == "[DONE]":
-                return
-            try:
-                chunk = json.loads(payload)
-            except ValueError:
-                continue
-            for choice in chunk.get("choices", []):
-                delta = choice.get("delta", {}).get("content")
-                if delta:
-                    yield delta
+    with tracer.span(
+        "cli.chat_request", endpoint="/v1/chat/completions",
+        messages=len(messages),
+    ) as span:
+        span.set_attribute("traceparent", format_traceparent(span.context()))
+        req = urllib.request.Request(
+            url.rstrip("/") + "/v1/chat/completions",
+            data=body,
+            headers=inject_headers({"Content-Type": "application/json"}),
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            server_trace = resp.headers.get("x-trace-id")
+            if server_trace:
+                span.set_attribute("server_trace_id", server_trace)
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[len("data:"):].strip()
+                if payload == "[DONE]":
+                    return
+                try:
+                    chunk = json.loads(payload)
+                except ValueError:
+                    continue
+                for choice in chunk.get("choices", []):
+                    delta = choice.get("delta", {}).get("content")
+                    if delta:
+                        yield delta
 
 
 def repl(

@@ -332,40 +332,48 @@ def upload_context(args, client, doc, progress=None):
     if url is None:
         raise SystemExit("controller never published a signed upload URL")
 
+    from substratus_tpu.observability.propagation import inject_headers
+    from substratus_tpu.observability.tracing import tracer
+
     try:
-        if args.fake and _FAKE_ENV is not None:
-            with open(tar_path, "rb") as f:
-                _FAKE_ENV.accept_upload(f.read(), md5)
-            if progress is not None:
-                progress(size, size)
+        with tracer.span(
+            "cli.upload", kind=kind, object=name, bytes=size,
+        ):
+            if args.fake and _FAKE_ENV is not None:
+                with open(tar_path, "rb") as f:
+                    _FAKE_ENV.accept_upload(f.read(), md5)
+                if progress is not None:
+                    progress(size, size)
+                else:
+                    print("uploaded to fake storage")
             else:
-                print("uploaded to fake storage")
-        else:
-            with open(tar_path, "rb") as f:
-                data = f if progress is None else _ProgressReader(
-                    f, size, progress
-                )
-                req = urllib.request.Request(
-                    url, data=data, method="PUT",
-                    headers={
-                        "Content-Type": "application/octet-stream",
-                        # Signed URLs are md5-bound; storage rejects a PUT
-                        # without the matching header (reference
-                        # client/upload.go:337, sci/kind/server.go:39).
-                        "Content-MD5": md5_b64,
-                        "Content-Length": str(size),
-                    },
-                )
-                with urllib.request.urlopen(req, timeout=300) as r:
-                    r.read()
-            if progress is None:
-                print(f"uploaded ({r.status})")
-            # nudge the controller (reference upload.go:184-189)
-            live = client.get(kind, ns, name)
-            live["metadata"].setdefault("annotations", {})[
-                "substratus.ai/upload-timestamp"
-            ] = str(time.time())
-            client.update(live)
+                with open(tar_path, "rb") as f:
+                    data = f if progress is None else _ProgressReader(
+                        f, size, progress
+                    )
+                    req = urllib.request.Request(
+                        url, data=data, method="PUT",
+                        # traceparent rides along so a storage-side proxy
+                        # (or the SCI local-FS handler) can join the trace.
+                        headers=inject_headers({
+                            "Content-Type": "application/octet-stream",
+                            # Signed URLs are md5-bound; storage rejects a
+                            # PUT without the matching header (reference
+                            # client/upload.go:337, sci/kind/server.go:39).
+                            "Content-MD5": md5_b64,
+                            "Content-Length": str(size),
+                        }),
+                    )
+                    with urllib.request.urlopen(req, timeout=300) as r:
+                        r.read()
+                if progress is None:
+                    print(f"uploaded ({r.status})")
+                # nudge the controller (reference upload.go:184-189)
+                live = client.get(kind, ns, name)
+                live["metadata"].setdefault("annotations", {})[
+                    "substratus.ai/upload-timestamp"
+                ] = str(time.time())
+                client.update(live)
     finally:
         os.unlink(tar_path)
     return obj
@@ -505,6 +513,44 @@ def stream_workload_logs(
     return 0
 
 
+def cmd_events(args) -> int:
+    """`sub events` — the controller event stream (reconcile transitions,
+    build lifecycle, upload handshakes) as `kubectl get events` renders
+    core/v1 Events. The controller's EventRecorder (observability/
+    events.py) upserts count-deduped Event objects; this lists them
+    newest-first. Works identically against the fake cluster."""
+    client = _client(args)
+    if args.fake and _FAKE_ENV is not None:
+        _FAKE_ENV.step()  # reconcile so just-applied CRs have narrated
+    evs = client.list("Event", args.namespace)
+    if not evs:
+        print("no events found")
+        return 0
+    evs.sort(key=lambda e: e.get("lastTimestamp", ""), reverse=True)
+    rows = [("LAST SEEN", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")]
+    for e in evs:
+        inv = e.get("involvedObject", {})
+        obj_ref = (
+            f"{inv.get('kind', '?').lower()}/{inv.get('name', '?')}"
+            if inv.get("kind") or inv.get("name") else "-"
+        )
+        rows.append(
+            (
+                e.get("lastTimestamp", "?"),
+                e.get("type", "?"),
+                e.get("reason", "?"),
+                obj_ref,
+                str(e.get("count", 1)),
+                e.get("message", ""),
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths) + "  {}"
+    for r in rows:
+        print(fmt.format(*r))
+    return 0
+
+
 def cmd_version(args) -> int:
     from substratus_tpu import __version__
 
@@ -565,6 +611,12 @@ def register(sub) -> None:
     p.add_argument("--no-open", action="store_true")
     common(p)
     p.set_defaults(func=cmd_notebook)
+
+    p = sub.add_parser(
+        "events", help="controller events (reconcile/build transitions)"
+    )
+    common(p)
+    p.set_defaults(func=cmd_events)
 
     p = sub.add_parser("logs", help="logs for a CR's workload")
     p.add_argument("kind")

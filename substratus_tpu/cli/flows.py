@@ -45,26 +45,38 @@ def _pick_manifests(args, prefer_kinds=_KIND_PREFERENCE):
 
 def _upload_stage(args, client, doc) -> tui.Progress:
     """Tar + signed-URL PUT with a live bar (reference uploadModel,
-    tui/upload.go:92-140); the protocol lives in commands.upload_context."""
+    tui/upload.go:92-140); the protocol lives in commands.upload_context.
+    The stage runs inside a `cli.flow.upload` span, so the flow's HTTP
+    calls carry a traceparent (observability/propagation.py)."""
     from substratus_tpu.cli.commands import upload_context
+    from substratus_tpu.observability.tracing import tracer
 
-    return tui.Progress(
-        "upload build context",
-        lambda progress: upload_context(args, client, doc, progress=progress),
-    )
+    def work(progress):
+        with tracer.span(
+            "cli.flow.upload", kind=doc["kind"],
+            object=doc["metadata"].get("name", "?"),
+        ):
+            return upload_context(args, client, doc, progress=progress)
+
+    return tui.Progress("upload build context", work)
 
 
 def _readiness_stage(args, client, obj) -> tui.Spinner:
     from substratus_tpu.cli.commands import _wait_ready
+    from substratus_tpu.observability.tracing import tracer
 
     kind, name = obj["kind"], obj["metadata"]["name"]
     ns = obj["metadata"]["namespace"]
-    return tui.Spinner(
-        f"waiting for {kind.lower()}/{name}",
-        lambda set_status: _wait_ready(
-            client, kind, ns, name, fake=args.fake, on_status=set_status
-        ),
-    )
+
+    def work(set_status):
+        with tracer.span(
+            "cli.flow.wait_ready", kind=kind, object=name, namespace=ns
+        ):
+            return _wait_ready(
+                client, kind, ns, name, fake=args.fake, on_status=set_status
+            )
+
+    return tui.Spinner(f"waiting for {kind.lower()}/{name}", work)
 
 
 def _logs_stage(args, client, obj) -> Optional[tui.LogView]:

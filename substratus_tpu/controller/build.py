@@ -21,6 +21,7 @@ from typing import Optional
 
 from substratus_tpu.api import conditions as C
 from substratus_tpu.cloud.base import Cloud
+from substratus_tpu.observability.events import EVENTS
 from substratus_tpu.controller.common import (
     SA_CONTAINER_BUILDER,
     job_state,
@@ -51,6 +52,7 @@ class BuildReconciler:
         build = spec.get("build")
         if not build:
             return Result()
+        md0 = obj["metadata"]
         git = build.get("git") or {}
         if git.get("tag") and git.get("branch"):
             # Tag OR branch, never both (reference common_types.go:32-47)
@@ -59,6 +61,12 @@ class BuildReconciler:
             set_condition(
                 obj, C.CONDITION_BUILT, False, C.REASON_INVALID_SPEC,
                 "build.git: set tag OR branch, not both",
+            )
+            EVENTS.emit(
+                "InvalidSpec", kind=obj["kind"],
+                namespace=md0["namespace"], name=md0["name"],
+                message="build.git: set tag OR branch, not both",
+                type="Warning",
             )
             write_status(self.client, obj)
             return Result()
@@ -95,13 +103,26 @@ class BuildReconciler:
                 # Target moved (e.g. new upload): recreate (ref :117-136).
                 self.client.delete("Job", ns, job_name)
                 job = self.client.create(desired)
+                EVENTS.emit(
+                    "BuildJobRecreated", kind=obj["kind"], namespace=ns,
+                    name=md["name"],
+                    message=f"target image moved; recreated job {job_name}",
+                )
         except NotFound:
             job = self.client.create(desired)
+            EVENTS.emit(
+                "BuildJobCreated", kind=obj["kind"], namespace=ns,
+                name=md["name"], message=f"created build job {job_name}",
+            )
 
         state = job_state(job)
         if state == "complete":
             set_condition(
                 obj, C.CONDITION_BUILT, True, C.REASON_BUILD_JOB_COMPLETE
+            )
+            EVENTS.emit(
+                "BuildComplete", kind=obj["kind"], namespace=ns,
+                name=md["name"], message=f"image built: {target_image}",
             )
             write_status(self.client, obj)
             fresh = self.client.get(obj["kind"], ns, md["name"])
@@ -112,6 +133,11 @@ class BuildReconciler:
             set_condition(
                 obj, C.CONDITION_BUILT, False, C.REASON_JOB_FAILED,
                 f"build job {job_name} failed",
+            )
+            EVENTS.emit(
+                "BuildFailed", kind=obj["kind"], namespace=ns,
+                name=md["name"], message=f"build job {job_name} failed",
+                type="Warning",
             )
             write_status(self.client, obj)
         else:
@@ -141,12 +167,18 @@ class BuildReconciler:
         )
         object_path = self._upload_object_path(obj, md5)
 
+        md = obj["metadata"]
         stored = self.sci.get_object_md5(
             self.cloud.cfg.artifact_bucket_url, object_path
         )
         if stored == md5:
             set_condition(
                 obj, C.CONDITION_UPLOADED, True, C.REASON_UPLOAD_FOUND
+            )
+            EVENTS.emit(
+                "UploadReceived", kind=obj["kind"],
+                namespace=md["namespace"], name=md["name"],
+                message=f"build context present (md5 {md5})",
             )
             status_upload["storedMd5Checksum"] = stored
             write_status(self.client, obj)
@@ -163,6 +195,13 @@ class BuildReconciler:
             )
         set_condition(
             obj, C.CONDITION_UPLOADED, False, C.REASON_AWAITING_UPLOAD
+        )
+        # Count-deduped: the 10 s poll below re-emits this every pass and
+        # the recorder folds them into one entry with a rising count.
+        EVENTS.emit(
+            "AwaitingUpload", kind=obj["kind"],
+            namespace=md["namespace"], name=md["name"],
+            message="signed URL published; waiting for client upload",
         )
         write_status(self.client, obj)
         # Poll storage until the client PUT lands (the client also patches an

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from substratus_tpu.kube.client import Conflict, KubeClient, NotFound, Obj
+from substratus_tpu.observability.events import EVENTS
 from substratus_tpu.observability.metrics import METRICS
 from substratus_tpu.observability.tracing import tracer
 
@@ -83,6 +84,12 @@ class Manager:
         self._wake = threading.Event()
         self._stop = threading.Event()
         client.add_listener(self._on_event)
+        # Controller event stream: reconcile transitions emitted through
+        # the shared recorder ALSO land as core/v1 Event objects on this
+        # client (`sub events` / kubectl get events). Event writes fan
+        # out to listeners but never enqueue work: Event is not a
+        # reconciled kind and carries no ownerReferences.
+        EVENTS.attach_kube(client)
 
     def register(self, kind: str, reconciler: Reconciler) -> None:
         self.reconcilers.setdefault(kind, []).append(reconciler)
@@ -198,17 +205,27 @@ class Manager:
                     "substratus_reconcile_conflicts_total", {"kind": kind}
                 )
                 span.set_attribute("outcome", "conflict")
+                EVENTS.emit(
+                    "ReconcileConflict", kind=kind, namespace=ns, name=name,
+                    message="optimistic-concurrency conflict; requeued",
+                )
                 self.enqueue(kind, ns, name)
                 return
             except NotFound:
                 span.set_attribute("outcome", "gone")
                 return
-            except Exception:
+            except Exception as e:
                 log.exception("reconcile %s %s/%s failed", kind, ns, name)
                 METRICS.inc(
                     "substratus_reconcile_errors_total", {"kind": kind}
                 )
                 span.set_attribute("outcome", "error")
+                # Exception TYPE only: the message could carry unbounded
+                # cardinality and would defeat the recorder's dedup.
+                EVENTS.emit(
+                    "ReconcileError", kind=kind, namespace=ns, name=name,
+                    message=type(e).__name__, type="Warning",
+                )
                 with self._lock:
                     self._delayed.append((time.monotonic() + 5.0, item))
                 return

@@ -38,6 +38,29 @@ def owner_reference(obj: Obj) -> Dict[str, Any]:
     }
 
 
+def workload_traceparent(obj: Obj) -> str:
+    """The TRACEPARENT env value stamped into a CR's workload containers,
+    read back by train/main.py and load/main.py so the job's spans join a
+    trace named after the CR.
+
+    Deliberately DETERMINISTIC (derived from the CR's identity, not the
+    live reconcile span): reconcile passes mint fresh span ids every
+    time, and a per-pass value in the pod template would read as spec
+    drift — reconcile_child would delete-and-recreate a running Job on
+    every reconcile. Stable identity -> stable env -> no churn; the
+    reconcile spans record the same id as `workload_trace_id` so the two
+    traces join in queries."""
+    from substratus_tpu.observability.propagation import (
+        deterministic_traceparent,
+    )
+
+    md = obj["metadata"]
+    return deterministic_traceparent(
+        obj["kind"], md.get("namespace", "default"), md["name"],
+        md.get("uid", ""),
+    )
+
+
 def resolve_env(env: Dict[str, str]) -> List[Dict[str, Any]]:
     """CR env -> container env; `${{ secrets.name.key }}` values become
     SecretKeyRef entries (reference utils.go:67-93)."""
@@ -102,7 +125,10 @@ def build_container(
         "image": spec.get("image"),
         "workingDir": CONTENT_DIR,
         "env": resolve_env(spec.get("env"))
-        + params_env(spec.get("params")),
+        + params_env(spec.get("params"))
+        # Distributed tracing across the spawn boundary: the job process
+        # (train/load main) parents its root span from this env var.
+        + [{"name": "TRACEPARENT", "value": workload_traceparent(obj)}],
     }
     if spec.get("command"):
         container["command"] = list(spec["command"])
@@ -124,8 +150,18 @@ def build_pod(
     restart_policy: str = "Never",
 ) -> Dict[str, Any]:
     """Pod template dict with params CM mount + bucket mounts + resources."""
+    from substratus_tpu.observability.tracing import tracer
+
     md = obj["metadata"]
     spec = obj.get("spec") or {}
+    # Joins the controller trace to the job trace: the reconcile span gets
+    # a child naming the deterministic trace id the workload will run
+    # under (see workload_traceparent).
+    with tracer.span(
+        "controller.plan_workload", kind=obj["kind"], workload=name,
+        workload_trace_id=workload_traceparent(obj).split("-")[1],
+    ):
+        pass
     pod_metadata: Dict[str, Any] = {
         "labels": {
             "app.kubernetes.io/managed-by": "substratus-tpu",

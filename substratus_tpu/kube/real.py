@@ -47,6 +47,8 @@ RESOURCE_MAP: Dict[str, tuple] = {
     "Deployment": ("/apis/apps/v1", "deployments"),
     "JobSet": ("/apis/jobset.x-k8s.io/v1alpha2", "jobsets"),
     "Lease": ("/apis/coordination.k8s.io/v1", "leases"),
+    # Controller event stream write-through (observability/events.py).
+    "Event": ("/api/v1", "events"),
     # Cluster-scoped, create-only review APIs (metrics RBAC —
     # observability/authz.py; kube-rbac-proxy parity).
     "TokenReview": ("/apis/authentication.k8s.io/v1", "tokenreviews"),

@@ -575,6 +575,34 @@ REGISTRY: Dict[str, Tuple[str, Dict[str, Any]]] = {
     "JobSet": ("jobset.x-k8s.io/v1alpha2", _sections(JOBSET_SPEC,
                                                      JOBSET_STATUS)),
     "Lease": ("coordination.k8s.io/v1", _sections(LEASE_SPEC)),
+    # core/v1 Event (flat top-level fields, no spec/status): the
+    # controller event stream (observability/events.py) upserts these so
+    # `sub events` / `kubectl get events` narrate reconcile transitions.
+    "Event": (
+        "v1",
+        _sections(
+            involvedObject=obj(
+                {
+                    "apiVersion": STR, "kind": STR, "namespace": STR,
+                    "name": STR, "uid": STR, "resourceVersion": STR,
+                    "fieldPath": STR,
+                }
+            ),
+            reason=STR,
+            message=STR,
+            type=enum("Normal", "Warning"),
+            count=INT,
+            firstTimestamp=STR,
+            lastTimestamp=STR,
+            eventTime=STR,
+            action=STR,
+            source=obj({"component": STR, "host": STR}),
+            reportingComponent=STR,
+            reportingInstance=STR,
+            related=OPEN,
+            series=OPEN,
+        ),
+    ),
     # Installed by `sub`/install manifests; apiextensions validation is the
     # apiserver's job, not a controller-emission surface — keep it open.
     "CustomResourceDefinition": ("apiextensions.k8s.io/v1",

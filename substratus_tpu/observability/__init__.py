@@ -11,7 +11,13 @@ Public API — import from here, not the submodules:
     tracing with contextvar propagation and JSONL export;
   * ``serve_health`` — /healthz /readyz /metrics HTTP(S) server with
     optional TokenReview/SubjectAccessReview RBAC (``MetricsAuthorizer``);
-  * ``lint_exposition`` — exposition-format validator (make metrics-lint).
+  * ``lint_exposition`` — exposition-format validator (make metrics-lint);
+  * ``parse_traceparent`` / ``format_traceparent`` / ``inject_headers`` /
+    ``context_from_env`` — W3C trace-context propagation across HTTP,
+    gRPC metadata, and spawned-job env vars (observability/propagation.py);
+  * ``EVENTS`` / ``EventRecorder`` — Kubernetes-Event-shaped, count-deduped
+    bounded event stream with optional kube write-through
+    (observability/events.py).
 """
 from substratus_tpu.observability.metrics import (  # noqa: F401
     LATENCY_BUCKETS,
@@ -29,9 +35,23 @@ from substratus_tpu.observability.tracing import (  # noqa: F401
     Tracer,
     tracer,
 )
+from substratus_tpu.observability.propagation import (  # noqa: F401
+    context_from_env,
+    current_traceparent,
+    deterministic_traceparent,
+    format_traceparent,
+    inject_headers,
+    parse_traceparent,
+)
+from substratus_tpu.observability.events import (  # noqa: F401
+    EVENTS,
+    EventRecorder,
+)
 from substratus_tpu.observability.health import serve_health  # noqa: F401
 
 __all__ = [
+    "EVENTS",
+    "EventRecorder",
     "LATENCY_BUCKETS",
     "METRICS",
     "RATIO_BUCKETS",
@@ -41,8 +61,13 @@ __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "context_from_env",
+    "current_traceparent",
+    "deterministic_traceparent",
     "escape_label_value",
-    "lint_exposition",
+    "format_traceparent",
+    "inject_headers",
+    "parse_traceparent",
     "serve_health",
     "tracer",
 ]

@@ -36,6 +36,14 @@ _current: "contextvars.ContextVar[Optional[SpanContext]]" = (
     contextvars.ContextVar("substratus_span", default=None)
 )
 
+# Distinguishes "parent not given" (inherit the contextvar) from an
+# EXPLICIT parent — including an explicit None, which means "root span".
+# Before this sentinel existed, a worker thread passing parent=None (e.g.
+# a Request whose submitter had no active span) silently inherited
+# whatever the contextvar held on that thread, mis-parenting the span
+# under export-ordering edge cases.
+_UNSET = object()
+
 
 class Span:
     """A single timed operation; use as a context manager. Exceptions
@@ -48,11 +56,11 @@ class Span:
 
     def __init__(
         self, tracer: "Tracer", name: str,
-        parent: Optional[SpanContext], attributes: Dict[str, object],
+        parent, attributes: Dict[str, object],
     ):
         self._tracer = tracer
         self.name = name
-        if parent is None:
+        if parent is _UNSET:
             parent = _current.get()
         self.trace_id = (
             parent.trace_id if parent else uuid.uuid4().hex
@@ -99,6 +107,26 @@ class Span:
         return False  # never swallow
 
 
+class _Attached:
+    """Context manager that pins `_current` to a given context (tracer
+    .attach). No span is recorded; exit restores the previous value."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: Optional[SpanContext]):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self) -> Optional[SpanContext]:
+        self._token = _current.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+        return False
+
+
 class Tracer:
     """Ring-buffered span collector. `capacity` bounds memory; JSONL export
     drains a snapshot without blocking recorders."""
@@ -108,15 +136,25 @@ class Tracer:
         self._spans: "deque[dict]" = deque(maxlen=capacity)
         self.dropped = 0  # spans evicted by the ring since the last clear
 
-    def span(
-        self, name: str, parent: Optional[SpanContext] = None, **attributes
-    ) -> Span:
+    def span(self, name: str, parent=_UNSET, **attributes) -> Span:
+        """A new span. `parent` semantics: omitted -> inherit the calling
+        context's active span (contextvar); an explicit SpanContext ->
+        that parent, authoritatively; an explicit None -> a ROOT span.
+        Explicit always wins — the contextvar is never consulted once the
+        caller said what the parent is."""
         return Span(self, name, parent, attributes)
 
     def current_context(self) -> Optional[SpanContext]:
         """The active span's context — capture this before handing work to
         another thread, then pass it as `parent=` there."""
         return _current.get()
+
+    def attach(self, ctx: Optional[SpanContext]):
+        """Adopt a (remote) context as the calling context's current span
+        without recording anything — subsequent spans parent under it.
+        Returns a context manager; a None ctx attaches 'no span' (useful
+        to isolate background work from an ambient trace)."""
+        return _Attached(ctx)
 
     def _record(self, span: dict) -> None:
         with self._lock:

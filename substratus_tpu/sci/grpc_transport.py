@@ -14,11 +14,23 @@ from typing import Optional
 
 import grpc
 
+from substratus_tpu.observability.propagation import (
+    current_traceparent, parse_traceparent,
+)
+from substratus_tpu.observability.tracing import tracer
 from substratus_tpu.sci import sci_pb2 as pb
 from substratus_tpu.sci.backends import SCIBackend
 from substratus_tpu.sci.client import SCIClient, SignedURL, traced
 
 SERVICE = "sci.v1.Controller"
+
+
+def _trace_metadata() -> Optional[tuple]:
+    """gRPC invocation metadata carrying the active span's traceparent —
+    the controller's reconcile trace survives into the SCI server process
+    (same W3C value HTTP uses; gRPC metadata keys must be lowercase)."""
+    tp = current_traceparent()
+    return (("traceparent", tp),) if tp is not None else None
 
 
 def _split_bucket(bucket_url: str) -> str:
@@ -54,7 +66,8 @@ class GrpcSCIClient(SCIClient):
                 object_name=object_path,
                 expiration_seconds=expiration_seconds,
                 md5_checksum=md5_checksum,
-            )
+            ),
+            metadata=_trace_metadata(),
         )
         return SignedURL(url=resp.url, expiration_seconds=expiration_seconds)
 
@@ -63,7 +76,8 @@ class GrpcSCIClient(SCIClient):
         resp = self._md5(
             pb.GetObjectMd5Request(
                 bucket_name=_split_bucket(bucket_url), object_name=object_path
-            )
+            ),
+            metadata=_trace_metadata(),
         )
         return resp.md5_checksum if resp.exists else None
 
@@ -74,33 +88,53 @@ class GrpcSCIClient(SCIClient):
                 principal=principal,
                 kubernetes_namespace=namespace,
                 kubernetes_service_account=name,
-            )
+            ),
+            metadata=_trace_metadata(),
         )
+
+
+def _server_span(method: str, context):
+    """Server-side span for one RPC, parented under the caller's
+    traceparent metadata when present (explicit None parent = a fresh
+    root trace — the server thread's contextvar is never consulted)."""
+    parent = None
+    if context is not None:
+        try:
+            meta = {k: v for k, v in (context.invocation_metadata() or ())}
+            parent = parse_traceparent(meta.get("traceparent"))
+        except Exception:  # noqa: BLE001 — tracing never fails an RPC
+            parent = None
+    return tracer.span(f"sci.server.{method}", parent=parent)
 
 
 def _handlers(backend: SCIBackend) -> grpc.GenericRpcHandler:
     def create_signed_url(request: pb.CreateSignedURLRequest, context):
-        url = backend.create_signed_url(
-            request.bucket_name,
-            request.object_name,
-            request.md5_checksum,
-            request.expiration_seconds or 300,
-        )
-        return pb.CreateSignedURLResponse(url=url)
+        with _server_span("CreateSignedURL", context):
+            url = backend.create_signed_url(
+                request.bucket_name,
+                request.object_name,
+                request.md5_checksum,
+                request.expiration_seconds or 300,
+            )
+            return pb.CreateSignedURLResponse(url=url)
 
     def get_object_md5(request: pb.GetObjectMd5Request, context):
-        md5 = backend.get_object_md5(request.bucket_name, request.object_name)
-        return pb.GetObjectMd5Response(
-            md5_checksum=md5 or "", exists=md5 is not None
-        )
+        with _server_span("GetObjectMd5", context):
+            md5 = backend.get_object_md5(
+                request.bucket_name, request.object_name
+            )
+            return pb.GetObjectMd5Response(
+                md5_checksum=md5 or "", exists=md5 is not None
+            )
 
     def bind_identity(request: pb.BindIdentityRequest, context):
-        backend.bind_identity(
-            request.principal,
-            request.kubernetes_namespace,
-            request.kubernetes_service_account,
-        )
-        return pb.BindIdentityResponse()
+        with _server_span("BindIdentity", context):
+            backend.bind_identity(
+                request.principal,
+                request.kubernetes_namespace,
+                request.kubernetes_service_account,
+            )
+            return pb.BindIdentityResponse()
 
     return grpc.method_handlers_generic_handler(
         SERVICE,

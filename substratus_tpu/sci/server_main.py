@@ -15,17 +15,39 @@ import logging
 
 
 def main(argv=None) -> int:
+    import os
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--backend", choices=["local", "gcs", "s3"], default="local")
     ap.add_argument("--port", type=int, default=10080)
     ap.add_argument("--http-port", type=int, default=30080)
     ap.add_argument("--bucket-root", default="/bucket")
     ap.add_argument("--external-host", default="localhost")
+    ap.add_argument(
+        "--trace-export",
+        default=os.environ.get("SUBSTRATUS_TRACE_EXPORT"),
+        help="JSONL path; buffered spans (per-RPC sci.server.* spans "
+        "included) are appended here on shutdown",
+    )
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
+    from substratus_tpu.observability.propagation import context_from_env
+    from substratus_tpu.observability.tracing import tracer
     from substratus_tpu.sci import backends
     from substratus_tpu.sci.grpc_transport import serve
+
+    # Whoever spawned this process (operator shell, a launcher Job) may
+    # hand down a TRACEPARENT env var; the startup span joins that trace
+    # so the JSONL export links back to the spawn.
+    with tracer.span(
+        "sci.server.start", parent=context_from_env(), backend=args.backend
+    ):
+        pass
+    if args.trace_export:
+        import atexit
+
+        atexit.register(tracer.export_jsonl, args.trace_export)
 
     if args.backend == "local":
         backend = backends.LocalFSBackend(

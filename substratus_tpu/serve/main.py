@@ -110,6 +110,21 @@ def main(argv=None) -> int:
 
     honor_requested_platform()
 
+    # Distributed tracing: join the spawner's trace (TRACEPARENT env —
+    # the controller stamps it into Server workloads) and, when
+    # SUBSTRATUS_TRACE_EXPORT is set, flush buffered spans there as JSONL
+    # on shutdown (hack/trace_lint.py validates the format).
+    from substratus_tpu.observability.propagation import context_from_env
+    from substratus_tpu.observability.tracing import tracer
+
+    with tracer.span("serve.start", parent=context_from_env()):
+        pass
+    trace_export = os.environ.get("SUBSTRATUS_TRACE_EXPORT")
+    if trace_export:
+        import atexit
+
+        atexit.register(tracer.export_jsonl, trace_export)
+
     # Multi-host slice: join the jax.distributed world the operator wired
     # (no-op on single hosts).
     maybe_initialize()

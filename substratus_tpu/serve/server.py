@@ -16,16 +16,24 @@ import asyncio
 import json
 import logging
 import math
+import os
 import time
 import uuid
 from typing import Optional
 
 from aiohttp import web
 
+from substratus_tpu.observability.events import EVENTS
 from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.propagation import parse_traceparent
 from substratus_tpu.observability.tracing import tracer
 from substratus_tpu.serve.engine import Engine, Request
 from substratus_tpu.serve.tokenizer import Tokenizer
+
+# Structured access log: one JSON line per traced request, carrying the
+# trace id so log pipelines join lines to span exports
+# (docs/observability.md "Joining logs to traces").
+access_log = logging.getLogger("substratus.serve.access")
 
 # Scrape-time engine gauges (request-latency histograms live in
 # serve/engine.py; the full catalog is docs/observability.md).
@@ -44,11 +52,32 @@ METRICS.describe(
 
 
 class ServerState:
-    def __init__(self, engine: Engine, tokenizer: Tokenizer, model_name: str):
+    def __init__(self, engine: Engine, tokenizer: Tokenizer, model_name: str,
+                 authorizer=None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.ready = True
+        # The /debug/* plane is gated by the same RBAC check as protected
+        # /metrics (observability/authz.py MetricsAuthorizer); None = open
+        # (local dev, no kube client to review tokens against).
+        self.authorizer = authorizer
+        # In-flight request registry for /debug/requestz: request id ->
+        # {req, endpoint, trace_id, start}. Mutated only on the event
+        # loop (track on submit, untrack when the handler finishes).
+        self.inflight: dict = {}
+
+    def track_request(self, req: Request, endpoint: str) -> None:
+        ctx = tracer.current_context()
+        self.inflight[req.id] = {
+            "req": req,
+            "endpoint": endpoint,
+            "trace_id": ctx.trace_id if ctx is not None else None,
+            "start": time.time(),
+        }
+
+    def untrack_request(self, req: Request) -> None:
+        self.inflight.pop(req.id, None)
 
     def render_chat(self, messages):
         """Messages -> (prompt, templated) using the MODEL'S chat
@@ -133,6 +162,77 @@ async def _collect(req: Request, tokenizer=None, stop=None) -> list[int]:
                 return out
 
 
+_TRACED_PREFIXES = ("/v1/", "/debug/")
+
+
+@web.middleware
+async def trace_middleware(request: web.Request, handler):
+    """Distributed-tracing boundary for the serving plane.
+
+    Parses the W3C `traceparent` request header (CLI and upstream proxies
+    inject it) and wraps the handler in a `serve.http` span parented under
+    the remote context — so one trace id survives CLI -> server -> engine.
+    The trace id is echoed as an `x-trace-id` response header (streamed
+    responses stamp it before prepare, see _stream), stamped into every
+    error payload, and logged as a structured access line. Probe and
+    scrape paths (`/`, `/metrics`) stay untraced — a 5 s Prometheus
+    scrape interval would otherwise dominate the span ring."""
+    if not request.path.startswith(_TRACED_PREFIXES):
+        return await handler(request)
+    remote = parse_traceparent(request.headers.get("traceparent"))
+    span = tracer.span(
+        "serve.http", parent=remote,
+        method=request.method, path=request.path,
+    )
+    t0 = time.perf_counter()
+    status = 500
+    try:
+        with span:
+            try:
+                resp = await handler(request)
+            except web.HTTPException as e:
+                # aiohttp error responses ARE responses; stamp the trace
+                # id so the client can quote it back.
+                status = e.status
+                span.set_attribute("http_status", e.status)
+                e.headers["x-trace-id"] = span.trace_id
+                raise
+            except Exception as e:  # noqa: BLE001 — unexpected: a JSON
+                # 500 with the trace id beats an opaque text 500 the
+                # operator can't correlate to a trace.
+                logging.getLogger(__name__).exception(
+                    "unhandled error serving %s", request.path
+                )
+                span.set_attribute("http_status", 500)
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "trace_id": span.trace_id},
+                    status=500, headers={"x-trace-id": span.trace_id},
+                )
+            status = resp.status
+            span.set_attribute("http_status", status)
+            if not resp.prepared:
+                resp.headers["x-trace-id"] = span.trace_id
+            return resp
+    finally:
+        access_log.info(
+            json.dumps(
+                {
+                    "event": "http_request",
+                    "method": request.method,
+                    "path": request.path,
+                    "status": status,
+                    "duration_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3
+                    ),
+                    "trace_id": span.trace_id,
+                    "span_id": span.span_id,
+                },
+                separators=(",", ":"),
+            )
+        )
+
+
 def _completion_body(state: ServerState, text: str, n_prompt: int,
                      n_gen: int, finish_reason: str = "stop"):
     return {
@@ -165,21 +265,145 @@ def build_app(state: ServerState) -> web.Application:
             return web.Response(status=500, text=str(state.engine.error))
         return web.Response(status=200 if state.ready else 503, text="ok")
 
+    async def _authorize_debug(request: web.Request) -> None:
+        """Gate a /debug/* route with the metrics RBAC check (TokenReview +
+        SubjectAccessReview through state.authorizer); open when no
+        authorizer is configured (local dev)."""
+        if state.authorizer is None:
+            return
+        loop = asyncio.get_running_loop()
+        status, reason = await loop.run_in_executor(
+            None, state.authorizer.allow,
+            request.headers.get("Authorization"),
+        )
+        if status == 200:
+            return
+        if status == 401:
+            raise web.HTTPUnauthorized(
+                text=reason, headers={"WWW-Authenticate": "Bearer"}
+            )
+        if status == 403:
+            raise web.HTTPForbidden(text=reason)
+        raise web.HTTPInternalServerError(text=reason)
+
     profile_lock = asyncio.Lock()
+    # On-demand capture state: {"dir", "started", "task"} while a
+    # start/stop capture is live, else empty.
+    profile_state: dict = {}
+    PROFILE_CAP_S = 60.0
+
+    def _profiler():
+        """The JAX profiler module, or None (no-op fallback: serving
+        builds without a working profiler still answer the endpoint)."""
+        try:
+            import jax
+
+            jax.profiler.start_trace  # attribute probe
+            return jax.profiler
+        except Exception:  # noqa: BLE001 — any import/attr failure = absent
+            return None
+
+    def _profile_dir() -> str:
+        base = os.environ.get("PROFILE_DIR", "/tmp/substratus-profile")
+        return os.path.join(base, time.strftime("%Y%m%d-%H%M%S"))
+
+    def _stop_capture(prof) -> dict:
+        """Stop the live capture; returns its summary (caller holds the
+        invariants: profile_state non-empty, prof available)."""
+        info = dict(profile_state)
+        profile_state.clear()
+        task = info.pop("task", None)
+        if task is not None:
+            task.cancel()
+        try:
+            prof.stop_trace()
+        except Exception as e:  # noqa: BLE001 — a capture that failed to
+            # start must still be clearable
+            info["stop_error"] = str(e)
+        elapsed = round(time.perf_counter() - info.pop("t0"), 3)
+        with tracer.span(
+            "serve.profile", mode="capture", dir=info.get("dir", ""),
+        ) as span:
+            span.set_attribute("seconds", elapsed)
+        EVENTS.emit(
+            "ProfileCaptureStopped", kind="Server", name=state.model_name,
+            message=f"device trace in {info.get('dir', '')}",
+        )
+        info["seconds"] = elapsed
+        return info
 
     @routes.post("/debug/profile")
     async def profile(request: web.Request) -> web.Response:
         """Capture a JAX/XLA device trace while serving traffic (SURVEY.md
         §5: the reference had no profiling story; here it is an endpoint).
-        Body: {"seconds": N (0 < N <= 60)}. Traces land in TensorBoard
-        format under a fixed base dir (PROFILE_DIR env overrides) — the
-        path is never caller-controlled."""
+
+        Two modes, both writing TensorBoard-format traces under a fixed
+        base dir (PROFILE_DIR env overrides; never caller-controlled):
+
+          * {"seconds": N (0 < N <= 60)} — blocking capture of N seconds;
+          * {"action": "start"} / {"action": "stop"} — on-demand capture
+            bracketing exactly the traffic you care about, with a 60 s
+            watchdog cap so a forgotten "stop" can't profile forever.
+
+        Every capture records a `serve.profile` span and a
+        ProfileCapture* event. Without a working profiler the endpoint
+        answers {"profiler": "unavailable"} instead of failing."""
+        await _authorize_debug(request)
         try:
             body = await request.json()
         except json.JSONDecodeError:
             body = {}
         if not isinstance(body, dict):
             raise web.HTTPBadRequest(text="body must be a JSON object")
+        prof = _profiler()
+        action = body.get("action")
+        if action not in (None, "start", "stop"):
+            raise web.HTTPBadRequest(text="'action' must be start or stop")
+
+        if action == "stop":
+            if not profile_state:
+                raise web.HTTPConflict(text="no profile capture is running")
+            if prof is None:  # started state can't exist without a profiler
+                profile_state.clear()
+                return web.json_response({"profiler": "unavailable"})
+            return web.json_response({"stopped": True, **_stop_capture(prof)})
+
+        if action == "start":
+            if profile_state or profile_lock.locked():
+                raise web.HTTPConflict(
+                    text="a profile capture is already running"
+                )
+            if prof is None:
+                return web.json_response(
+                    {"profiler": "unavailable", "started": False}
+                )
+            out_dir = _profile_dir()
+            try:
+                prof.start_trace(out_dir)
+            except Exception as e:  # noqa: BLE001
+                raise web.HTTPInternalServerError(
+                    text=f"profiler failed to start: {e}"
+                )
+            EVENTS.emit(
+                "ProfileCaptureStarted", kind="Server",
+                name=state.model_name, message=f"device trace to {out_dir}",
+            )
+
+            async def watchdog():
+                await asyncio.sleep(PROFILE_CAP_S)
+                if profile_state:
+                    _stop_capture(prof)
+
+            profile_state.update(
+                {"dir": out_dir, "t0": time.perf_counter(),
+                 "task": asyncio.get_running_loop().create_task(watchdog())}
+            )
+            return web.json_response(
+                {"started": True, "dir": out_dir,
+                 "cap_seconds": PROFILE_CAP_S}
+            )
+
+        # Blocking mode: {"seconds": N}.
         try:
             seconds = float(body.get("seconds", 3))
         except (TypeError, ValueError):
@@ -187,28 +411,150 @@ def build_app(state: ServerState) -> web.Application:
         if not (0 < seconds <= 60):
             raise web.HTTPBadRequest(text="'seconds' must be in (0, 60]")
 
-        import os
-
-        base = os.environ.get("PROFILE_DIR", "/tmp/substratus-profile")
-        out_dir = os.path.join(base, time.strftime("%Y%m%d-%H%M%S"))
-
-        if profile_lock.locked():
+        out_dir = _profile_dir()
+        if profile_lock.locked() or profile_state:
             raise web.HTTPConflict(text="a profile capture is already running")
+        if prof is None:
+            return web.json_response(
+                {"profiler": "unavailable", "dir": out_dir, "files": []}
+            )
         async with profile_lock:
-            import jax
-
             loop = asyncio.get_running_loop()
 
             def capture():
-                with jax.profiler.trace(out_dir):
-                    time.sleep(seconds)
+                with tracer.span(
+                    "serve.profile", mode="blocking", dir=out_dir,
+                    seconds=seconds,
+                ):
+                    prof.start_trace(out_dir)
+                    try:
+                        time.sleep(seconds)
+                    finally:
+                        prof.stop_trace()
 
             await loop.run_in_executor(None, capture)
+        EVENTS.emit(
+            "ProfileCaptureStopped", kind="Server", name=state.model_name,
+            message=f"device trace in {out_dir}",
+        )
         files = []
         for root, _, names in os.walk(out_dir):
             files.extend(os.path.join(root, n) for n in names)
         return web.json_response(
             {"dir": out_dir, "seconds": seconds, "files": sorted(files)[-10:]}
+        )
+
+    @routes.get("/debug/tracez")
+    async def tracez(request: web.Request) -> web.Response:
+        """Flight recorder: recent traces from the span ring, grouped by
+        root span and latency-bucketed — the 'what has the server been
+        doing' page, no collector required."""
+        await _authorize_debug(request)
+        spans = tracer.finished()
+        by_trace: dict = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        buckets = (0.01, 0.1, 1.0)  # seconds; final bucket is +Inf
+
+        def bucket_label(duration_us: int) -> str:
+            sec = duration_us / 1e6
+            for b in buckets:
+                if sec <= b:
+                    return f"le_{b}s"
+            return "gt_1s"
+
+        traces = []
+        by_root: dict = {}
+        for tid, ss in by_trace.items():
+            ids = {s["span_id"] for s in ss}
+            # Root = no parent, or a parent outside the buffer (remote
+            # caller / ring-evicted ancestor).
+            root = next(
+                (s for s in ss
+                 if not s["parent_id"] or s["parent_id"] not in ids),
+                ss[0],
+            )
+            errors = [s["status"] for s in ss if s["status"] != "ok"]
+            traces.append(
+                {
+                    "trace_id": tid,
+                    "root": root["name"],
+                    "start_us": root["start_us"],
+                    "duration_us": root["duration_us"],
+                    "spans": len(ss),
+                    "status": errors[0] if errors else "ok",
+                }
+            )
+            hist = by_root.setdefault(
+                root["name"],
+                {f"le_{b}s": 0 for b in buckets} | {"gt_1s": 0},
+            )
+            hist[bucket_label(root["duration_us"])] += 1
+        traces.sort(key=lambda t: t["start_us"], reverse=True)
+        return web.json_response(
+            {
+                "traces": traces[:100],
+                "latency_buckets": by_root,
+                "buffered_spans": len(spans),
+                "dropped_spans": tracer.dropped,
+            }
+        )
+
+    @routes.get("/debug/requestz")
+    async def requestz(request: web.Request) -> web.Response:
+        """In-flight completion requests: age, where each one is in the
+        engine (decoding slot / queue position), tokens emitted so far."""
+        await _authorize_debug(request)
+        eng = state.engine
+        now = time.time()
+        # Snapshots; the scheduler thread mutates these concurrently and
+        # a debug page may be slightly stale, never wrong-by-crash.
+        slot_req = list(eng.slot_req)
+        queued = list(getattr(eng.queue, "queue", ()))
+        rows = []
+        for info in list(state.inflight.values()):
+            req = info["req"]
+            slot = next(
+                (i for i, r in enumerate(slot_req) if r is req), None
+            )
+            if slot is not None:
+                where = "decoding"
+                tokens = eng.slot_generated[slot]
+                queue_position = None
+            else:
+                pos = next(
+                    (i for i, r in enumerate(queued) if r is req), None
+                )
+                where = "queued" if pos is not None else "pending"
+                tokens = 0
+                queue_position = pos
+            rows.append(
+                {
+                    "request_id": req.id,
+                    "endpoint": info["endpoint"],
+                    "trace_id": info["trace_id"],
+                    "age_s": round(now - info["start"], 3),
+                    "state": where,
+                    "slot": slot,
+                    "queue_position": queue_position,
+                    "prompt_tokens": len(req.prompt_tokens),
+                    "max_tokens": req.max_tokens,
+                    "tokens_emitted": tokens,
+                }
+            )
+        rows.sort(key=lambda r: r["age_s"], reverse=True)
+        return web.json_response(
+            {"inflight": rows, "queue_depth": eng.queue.qsize()}
+        )
+
+    @routes.get("/debug/eventz")
+    async def eventz(request: web.Request) -> web.Response:
+        """Recent events from the shared recorder (count-deduped, newest
+        first) — reconcile transitions when a controller shares the
+        process, profile captures, anything emitted through EVENTS."""
+        await _authorize_debug(request)
+        return web.json_response(
+            {"events": EVENTS.recent(100), "dropped": EVENTS.dropped}
         )
 
     @routes.get("/metrics")
@@ -284,7 +630,8 @@ def build_app(state: ServerState) -> web.Application:
                         text="'top_p' must be in (0, 1]"
                     )
 
-    def _submit(prompt: str, body: dict, templated: bool = False) -> Request:
+    def _submit(prompt: str, body: dict, endpoint: str,
+                templated: bool = False) -> Request:
         tok = state.tokenizer
         req = Request(
             prompt_tokens=state.encode_prompt(prompt, templated),
@@ -294,15 +641,19 @@ def build_app(state: ServerState) -> web.Application:
             eos_token_id=tok.eos_id,
             id=uuid.uuid4().hex,
         )
+        state.track_request(req, endpoint)
         return state.engine.submit(req)
 
     async def _generate(request: web.Request, prompt: str, body: dict,
                         templated: bool = False):
-        req = _submit(prompt, body, templated)
-        stop = body.get("stop")
-        if isinstance(stop, str):
-            stop = [stop]
-        gen_ids = await _collect(req, state.tokenizer, stop)
+        req = _submit(prompt, body, request.path, templated)
+        try:
+            stop = body.get("stop")
+            if isinstance(stop, str):
+                stop = [stop]
+            gen_ids = await _collect(req, state.tokenizer, stop)
+        finally:
+            state.untrack_request(req)
         if state.engine.error is not None:
             raise web.HTTPInternalServerError(text=str(state.engine.error))
         text = state.tokenizer.decode(gen_ids)
@@ -324,18 +675,24 @@ def build_app(state: ServerState) -> web.Application:
         """OpenAI-style SSE streaming: one data: chunk per decoded token,
         then [DONE]. The engine already streams per-token through the
         request queue; this just relays it."""
-        req = _submit(prompt, body, templated)
+        req = _submit(prompt, body, request.path, templated)
         if state.engine.error is not None:
+            state.untrack_request(req)
             raise web.HTTPInternalServerError(text=str(state.engine.error))
         stop = body.get("stop")
         if isinstance(stop, str):
             stop = [stop]
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-            }
-        )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        }
+        # SSE headers go out at prepare(), before the middleware sees the
+        # response — stamp the trace id here (same id the middleware span
+        # carries: we're inside it).
+        ctx = tracer.current_context()
+        if ctx is not None:
+            headers["x-trace-id"] = ctx.trace_id
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         loop = asyncio.get_running_loop()
         created = int(time.time())
@@ -373,55 +730,65 @@ def build_app(state: ServerState) -> web.Application:
         tokens: list[int] = []
         sent = 0  # chars already streamed
         finish_reason: Optional[str] = None
-        while True:
-            tok_id = await loop.run_in_executor(None, req.out.get)
-            if tok_id is None:
+        async def pump():
+            """Relay tokens until the request finishes (split out so
+            untracking can't be skipped by any of the loop's exits)."""
+            nonlocal sent, finish_reason
+            while True:
+                tok_id = await loop.run_in_executor(None, req.out.get)
+                if tok_id is None:
+                    full = state.tokenizer.decode(tokens)
+                    if stop and (cut := _find_stop(full, stop)) is not None:
+                        full, finish_reason = full[:cut], "stop"
+                    else:
+                        # The engine reports "error" on the request itself
+                        # when its thread died mid-stream — the committed
+                        # 200 stream then ends honestly instead of
+                        # fabricating "stop".
+                        finish_reason = req.finish_reason
+                    if len(full) > sent:
+                        await write_piece(full[sent:])
+                    return
+                tokens.append(tok_id)
                 full = state.tokenizer.decode(tokens)
-                if stop and (cut := _find_stop(full, stop)) is not None:
-                    full, finish_reason = full[:cut], "stop"
-                else:
-                    # The engine reports "error" on the request itself when
-                    # its thread died mid-stream — the committed 200 stream
-                    # then ends honestly instead of fabricating "stop".
-                    finish_reason = req.finish_reason
-                if len(full) > sent:
-                    await write_piece(full[sent:])
-                break
-            tokens.append(tok_id)
-            full = state.tokenizer.decode(tokens)
-            if stop:
-                # A new match must end inside the unsent tail (plus the
-                # holdback window) — search only there.
-                base = max(0, sent - holdback)
-                cut = _find_stop(full[base:], stop)
-                if cut is not None:
-                    cut += base
-                    if cut > sent:
-                        await write_piece(full[sent:cut])
-                        sent = cut
-                    req.cancelled = True
-                    while (
-                        await loop.run_in_executor(None, req.out.get)
-                        is not None
-                    ):
-                        pass
-                    finish_reason = "stop"
-                    break
-            # Hold back the stop window plus any trailing partial UTF-8
-            # codepoint (<= 3 replacement chars; a longer run is genuinely
-            # invalid output and streams as-is).
-            emit_to = len(full) - holdback
-            trail = 0
-            while (
-                trail < 3
-                and emit_to - 1 - trail >= 0
-                and full[emit_to - 1 - trail] == "�"
-            ):
-                trail += 1
-            emit_to -= trail if trail < 3 else 0
-            if emit_to > sent:
-                await write_piece(full[sent:emit_to])
-                sent = emit_to
+                if stop:
+                    # A new match must end inside the unsent tail (plus the
+                    # holdback window) — search only there.
+                    base = max(0, sent - holdback)
+                    cut = _find_stop(full[base:], stop)
+                    if cut is not None:
+                        cut += base
+                        if cut > sent:
+                            await write_piece(full[sent:cut])
+                            sent = cut
+                        req.cancelled = True
+                        while (
+                            await loop.run_in_executor(None, req.out.get)
+                            is not None
+                        ):
+                            pass
+                        finish_reason = "stop"
+                        return
+                # Hold back the stop window plus any trailing partial UTF-8
+                # codepoint (<= 3 replacement chars; a longer run is
+                # genuinely invalid output and streams as-is).
+                emit_to = len(full) - holdback
+                trail = 0
+                while (
+                    trail < 3
+                    and emit_to - 1 - trail >= 0
+                    and full[emit_to - 1 - trail] == "�"
+                ):
+                    trail += 1
+                emit_to -= trail if trail < 3 else 0
+                if emit_to > sent:
+                    await write_piece(full[sent:emit_to])
+                    sent = emit_to
+
+        try:
+            await pump()
+        finally:
+            state.untrack_request(req)
         await write_piece("", finish_reason)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
@@ -488,7 +855,7 @@ def build_app(state: ServerState) -> web.Application:
         ]
         return web.json_response(resp)
 
-    app = web.Application()
+    app = web.Application(middlewares=[trace_middleware])
     app.add_routes(routes)
     return app
 

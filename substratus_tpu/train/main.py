@@ -226,29 +226,53 @@ def main(argv=None) -> int:
         tokens_per_step=batch_size * seq_len,
         peak_flops=device_peak_flops(),
     )
+    # Distributed tracing: the controller stamps a TRACEPARENT env var
+    # into the training Job's container (controller/workloads.py), so this
+    # run's spans — and every StepLogger JSON line, which carries the
+    # active trace/span ids — join the trace that spawned it. The spans
+    # export as JSONL next to the artifacts (or SUBSTRATUS_TRACE_EXPORT).
+    from substratus_tpu.observability.propagation import context_from_env
+    from substratus_tpu.observability.tracing import tracer
+
     tracing = False
-    for step in range(start_step, steps):
-        if prof_range and step == prof_range[0]:
-            jax.profiler.start_trace(os.path.join(args.out, "profile"))
-            tracing = True
-        t_step = time.perf_counter()
-        loss = trainer.train_step(next(data))
-        step_log.log_step(
-            step, float(loss), time.perf_counter() - t_step,
-            last=step == steps - 1,
-        )
-        if tracing and step == prof_range[1]:
-            jax.profiler.stop_trace()
-            tracing = False
-        trainable = trainer.lora if trainer.lora is not None else trainer.params
-        ckpt.maybe_save(
-            step + 1,
-            {"trainable": trainable, "opt_state": trainer.opt_state},
-            force=(step == steps - 1),
-        )
+    with tracer.span(
+        "train.run", parent=context_from_env(),
+        steps=steps, start_step=start_step, batch_size=batch_size,
+        seq_len=seq_len, lora_rank=lora_rank,
+    ):
+        for step in range(start_step, steps):
+            if prof_range and step == prof_range[0]:
+                jax.profiler.start_trace(os.path.join(args.out, "profile"))
+                tracing = True
+            t_step = time.perf_counter()
+            loss = trainer.train_step(next(data))
+            step_log.log_step(
+                step, float(loss), time.perf_counter() - t_step,
+                last=step == steps - 1,
+            )
+            if tracing and step == prof_range[1]:
+                jax.profiler.stop_trace()
+                tracing = False
+            trainable = (
+                trainer.lora if trainer.lora is not None else trainer.params
+            )
+            ckpt.maybe_save(
+                step + 1,
+                {"trainable": trainable, "opt_state": trainer.opt_state},
+                force=(step == steps - 1),
+            )
     if tracing:
         jax.profiler.stop_trace()
     ckpt.close()
+    try:
+        tracer.export_jsonl(
+            os.environ.get(
+                "SUBSTRATUS_TRACE_EXPORT",
+                os.path.join(args.out, "trace.jsonl"),
+            )
+        )
+    except OSError as e:
+        print(f"trace export failed (continuing): {e}", flush=True)
 
     final = (
         merge_lora(trainer.params, trainer.lora, trainer.lora_scale)

@@ -21,6 +21,7 @@ from substratus_tpu.observability.metrics import (
     RATIO_BUCKETS,
     THROUGHPUT_BUCKETS,
 )
+from substratus_tpu.observability.tracing import tracer
 
 log = logging.getLogger("substratus.train")
 
@@ -124,5 +125,13 @@ class StepLogger:
                 time.perf_counter() - self._t_start, 1
             ),
         }
+        # Log/trace join: inside a span (train/main.py wraps the run in
+        # `train.run`, itself parented from the spawning controller's
+        # TRACEPARENT) every progress line names its trace — grep a slow
+        # step's trace_id straight out of the container logs.
+        ctx = tracer.current_context()
+        if ctx is not None:
+            record["trace_id"] = ctx.trace_id
+            record["span_id"] = ctx.span_id
         self._emit(json.dumps(record, separators=(",", ":")))
         return record

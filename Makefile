@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench graft image install-manifests
+.PHONY: test test-int metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -45,6 +45,29 @@ spm:
 
 bench:
 	$(PY) bench.py
+
+# The second BASELINE primary metric: 7B LoRA finetune step-time.
+bench-train:
+	$(PY) tools/bench_train.py
+
+# CPU-scaled captures of BOTH baseline primary metrics plus the
+# 2-process lockstep gang bench, each piped through the schema validator
+# — proves every capture path emits one valid JSON line without a chip.
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --config tiny --batch 4 --cache-len 128 \
+	  --steps 8 --quantize int8 --no-fallback --probe-timeout 60 \
+	  --probe-budget 120 | $(PY) hack/bench_compare.py --validate -
+	JAX_PLATFORMS=cpu $(PY) tools/bench_train.py --smoke \
+	  | $(PY) hack/bench_compare.py --validate -
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --gang 2 \
+	  --transport tcp --long-admission 8200 \
+	  | $(PY) hack/bench_compare.py --validate -
+
+# Bench JSON schema + >10% regression gate (hack/bench_compare.py):
+# self-tests that a synthetic 20% regression fails and that the repo's
+# historical BENCH_* trajectory still loads.
+bench-compare:
+	$(PY) hack/bench_compare.py --self-test
 
 graft:
 	$(PY) __graft_entry__.py

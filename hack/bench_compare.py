@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Bench capture schema validation + regression gate (make bench-compare).
+
+The capture ladder (bench.py, tools/bench_train.py, tools/engine_bench.py)
+promises ONE parseable JSON line per run with a fixed shape. This tool is
+the consumer that holds the promise:
+
+  * `--validate FILE|-` — the last non-empty line must parse as a capture
+    record: metric/unit strings, value a finite positive number or null,
+    null values carrying an `error`. CI pipes every smoke capture
+    through this, so a formatting regression fails before a round is lost
+    to an unparseable artifact.
+  * `--new FILE|- [--history GLOB ...]` — compare a fresh capture against
+    the recorded trajectory (BENCH_*.json driver artifacts — the
+    `{n, cmd, rc, tail, parsed}` wrapper — or bare capture lines) and
+    fail on a regression worse than --threshold (default 10%). Direction
+    is metric-aware: step-time/latency metrics (unit ms/*, or
+    "step_time"/"latency" in the name) regress UP; throughput regresses
+    DOWN. A new capture with value=null cannot prove no regression and
+    fails the gate outright.
+  * `--self-test` — the gate must actually gate: a synthetic 20%
+    regression in each direction must fail, an unchanged capture must
+    pass, and every historical BENCH_r0*.json in the repo must still
+    load. A comparator that accepts garbage compares nothing.
+
+Exit 0 = clean, 1 = validation/regression problems (each listed on
+stderr). No jax, no device access — runs anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+sys.dont_write_bytecode = True
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = ("BENCH_*.json",)
+DEFAULT_THRESHOLD = 0.10
+
+LOWER_IS_BETTER_UNITS = ("ms", "seconds", "s/step")
+LOWER_IS_BETTER_NAMES = ("step_time", "latency", "ttft")
+
+
+def lower_is_better(record: dict) -> bool:
+    unit = str(record.get("unit", "")).lower()
+    metric = str(record.get("metric", "")).lower()
+    return any(u in unit for u in LOWER_IS_BETTER_UNITS) or any(
+        n in metric for n in LOWER_IS_BETTER_NAMES
+    )
+
+
+def validate_record(record, where: str = "capture") -> list:
+    """Schema problems of one capture record (empty = valid)."""
+    problems = []
+    if not isinstance(record, dict):
+        return [f"{where}: not a JSON object"]
+    metric = record.get("metric")
+    if not isinstance(metric, str) or not metric:
+        problems.append(f"{where}: missing/empty 'metric'")
+    if not isinstance(record.get("unit"), str) or not record.get("unit"):
+        problems.append(f"{where}: missing/empty 'unit'")
+    value = record.get("value", "absent")
+    if value == "absent":
+        problems.append(f"{where}: missing 'value'")
+    elif value is None:
+        if not record.get("error"):
+            problems.append(
+                f"{where}: null value without an 'error' (a failed "
+                "capture must say why)"
+            )
+    elif isinstance(value, bool) or not isinstance(value, (int, float)):
+        problems.append(f"{where}: value {value!r} is not a number or null")
+    elif not math.isfinite(value) or value <= 0:
+        problems.append(f"{where}: value {value!r} is not finite positive")
+    return problems
+
+
+def last_json_line(text: str, where: str):
+    """(record, problems) from the LAST non-empty line — the single-line
+    contract every bench guarantees even on failure."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        return None, [f"{where}: empty input"]
+    try:
+        return json.loads(lines[-1]), []
+    except ValueError as e:
+        return None, [f"{where}: last line is not JSON ({e})"]
+
+
+def load_history(patterns) -> tuple:
+    """Historical captures -> ({metric: (source, value, record)}, problems).
+    Keeps the LATEST non-null value per metric (files sorted by name, so
+    BENCH_r05 beats BENCH_r01). Accepts both driver wrappers
+    ({"parsed": <capture>|null}) and bare capture records; a null
+    `parsed` is a failed round — legal history, nothing to compare."""
+    problems: list = []
+    latest: dict = {}
+    paths: list = []
+    for pat in patterns:
+        hits = sorted(glob.glob(pat if os.path.isabs(pat)
+                                else os.path.join(REPO, pat)))
+        if not hits and glob.escape(pat) == pat and not os.path.exists(pat):
+            problems.append(f"history pattern {pat!r} matched nothing")
+        paths.extend(hits)
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError as e:
+            problems.append(f"{name}: not valid JSON ({e})")
+            continue
+        record = doc.get("parsed", doc) if isinstance(doc, dict) else doc
+        if record is None:
+            continue  # failed round, recorded as such
+        probs = validate_record(record, name)
+        if probs:
+            problems.extend(probs)
+            continue
+        if record["value"] is None:
+            continue  # null capture: carries diagnostics, no number
+        latest[record["metric"]] = (name, float(record["value"]), record)
+    return latest, problems
+
+
+def compare(new: dict, history: dict, threshold: float) -> list:
+    """Regression problems of `new` vs the trajectory (empty = pass)."""
+    problems = validate_record(new, "new capture")
+    if problems:
+        return problems
+    if new["value"] is None:
+        return [
+            "new capture has value=null "
+            f"({str(new.get('error', ''))[:200]}): cannot prove no "
+            "regression"
+        ]
+    metric = new["metric"]
+    if metric not in history:
+        print(
+            f"bench-compare: no history for {metric!r}; "
+            "nothing to compare (pass)",
+        )
+        return []
+    source, old, _ = history[metric]
+    new_v = float(new["value"])
+    if lower_is_better(new):
+        limit = old * (1.0 + threshold)
+        if new_v > limit:
+            return [
+                f"{metric}: {new_v:g} exceeds {source}'s {old:g} by "
+                f">{threshold:.0%} (limit {limit:g}) — step-time regression"
+            ]
+        change = (old - new_v) / old
+    else:
+        limit = old * (1.0 - threshold)
+        if new_v < limit:
+            return [
+                f"{metric}: {new_v:g} is >{threshold:.0%} below "
+                f"{source}'s {old:g} (limit {limit:g}) — throughput "
+                "regression"
+            ]
+        change = (new_v - old) / old
+    print(
+        f"bench-compare: {metric} {new_v:g} vs {source} {old:g} "
+        f"({change:+.1%}, threshold {threshold:.0%}): ok"
+    )
+    return []
+
+
+def self_test() -> list:
+    """The gate must gate. Returns failure strings (empty = ok)."""
+    failures = []
+    hist = {
+        "x_throughput": ("r1", 100.0, {}),
+        "x_step_time": ("r1", 100.0, {}),
+    }
+    up = {"metric": "x_throughput", "unit": "tokens/sec", "value": 80.0}
+    down = {"metric": "x_step_time", "unit": "ms/step", "value": 120.0}
+    same_up = {**up, "value": 100.0}
+    same_down = {**down, "value": 100.0}
+    just_in = [
+        {**up, "value": 91.0},  # -9%: inside the 10% band
+        {**down, "value": 109.0},
+    ]
+    if not compare(up, hist, DEFAULT_THRESHOLD):
+        failures.append("20% throughput regression not flagged")
+    if not compare(down, hist, DEFAULT_THRESHOLD):
+        failures.append("20% step-time regression not flagged")
+    for rec in (same_up, same_down, *just_in):
+        if compare(rec, hist, DEFAULT_THRESHOLD):
+            failures.append(f"clean capture flagged: {rec}")
+    null_cap = {"metric": "x_throughput", "unit": "t/s", "value": None,
+                "error": "backend unavailable"}
+    if validate_record(null_cap):
+        failures.append("contractual null capture failed validation")
+    if not compare(null_cap, hist, DEFAULT_THRESHOLD):
+        failures.append("null new capture passed the gate")
+    for bad in (
+        {"unit": "t/s", "value": 1},
+        {"metric": "m", "unit": "t/s", "value": float("nan")},
+        {"metric": "m", "unit": "t/s", "value": -1},
+        {"metric": "m", "unit": "t/s", "value": None},  # null, no error
+        {"metric": "m", "unit": "t/s"},  # value absent
+    ):
+        if not validate_record(bad):
+            failures.append(f"invalid record accepted: {bad}")
+    # The repo's real trajectory must load (acceptance criterion).
+    history, problems = load_history(DEFAULT_HISTORY)
+    failures += [f"historical file: {p}" for p in problems]
+    return failures
+
+
+def read_input(arg: str) -> str:
+    if arg == "-":
+        return sys.stdin.read()
+    with open(arg) as f:
+        return f.read()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--validate", metavar="FILE|-",
+        help="validate the last JSON line of FILE (or stdin) as a "
+             "capture record",
+    )
+    ap.add_argument(
+        "--new", metavar="FILE|-",
+        help="fresh capture (last JSON line) to gate against the history",
+    )
+    ap.add_argument(
+        "--history", nargs="*", default=list(DEFAULT_HISTORY),
+        help="history file globs, relative to the repo root "
+             "(default: BENCH_*.json)",
+    )
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    ap.add_argument("--self-test", action="store_true")
+    a = ap.parse_args(argv)
+
+    problems: list = []
+    ran = False
+    if a.self_test:
+        ran = True
+        problems += self_test()
+    if a.validate is not None:
+        ran = True
+        record, probs = last_json_line(
+            read_input(a.validate), a.validate
+        )
+        problems += probs
+        if record is not None:
+            problems += validate_record(record, a.validate)
+            if not problems:
+                print(
+                    f"bench-compare: valid capture "
+                    f"({record['metric']} = {record['value']})"
+                )
+    if a.new is not None:
+        ran = True
+        record, probs = last_json_line(read_input(a.new), a.new)
+        problems += probs
+        if record is not None:
+            history, hist_probs = load_history(a.history)
+            problems += hist_probs
+            problems += compare(record, history, a.threshold)
+    if not ran:
+        ap.error("nothing to do: pass --validate, --new, or --self-test")
+    if problems:
+        for p in problems:
+            print(f"bench-compare: {p}", file=sys.stderr)
+        return 1
+    print("bench-compare: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,6 +29,7 @@ from jax import lax
 from substratus_tpu.ops.attention import dot_product_attention
 from substratus_tpu.ops.basics import rms_norm, rope, swiglu, lora_delta
 from substratus_tpu.ops.quant import materialize, qeinsum, qeinsum_w8a8
+from substratus_tpu.utils import jaxcompat
 
 Params = Dict[str, Any]
 
@@ -319,7 +320,7 @@ def _self_attention(
             )
 
         spec = P(None, "sequence", None, None)
-        sharded = jax.shard_map(
+        sharded = jaxcompat.shard_map(
             lambda q, k, v: fn(q, k, v, axis_name="sequence"),
             in_specs=(spec, spec, spec),
             out_specs=spec,

@@ -28,6 +28,7 @@ from substratus_tpu.observability.metrics import (  # noqa: F401
     Metrics,
     escape_label_value,
     lint_exposition,
+    quantile_from_buckets,
 )
 from substratus_tpu.observability.tracing import (  # noqa: F401
     Span,
@@ -68,6 +69,7 @@ __all__ = [
     "format_traceparent",
     "inject_headers",
     "parse_traceparent",
+    "quantile_from_buckets",
     "serve_health",
     "tracer",
 ]

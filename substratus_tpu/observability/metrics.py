@@ -214,6 +214,33 @@ class Metrics:
                 return float(self._hists[key].count)
             return self.counters.get(key)
 
+    def histogram_series(self, name: str) -> Dict[str, dict]:
+        """Snapshot of one histogram family, keyed by the canonical label
+        string ("" for unlabeled):
+
+            {label_str: {"buckets": [(le, cumulative_count), ...,
+                         (inf, count)], "sum": float, "count": int}}
+
+        Empty dict when the family is unknown or has no observations.
+        This is the read API behind /debug/perfz and the gang bench —
+        consumers get the same cumulative-bucket data a Prometheus scrape
+        would, without parsing the text exposition."""
+        with self._lock:
+            bs = self._buckets.get(name)
+            if bs is None:
+                return {}
+            out: Dict[str, dict] = {}
+            for (n, ls), h in self._hists.items():
+                if n != name:
+                    continue
+                cum = 0
+                buckets = []
+                for bound, c in zip(tuple(bs) + (math.inf,), h.counts):
+                    cum += c
+                    buckets.append((bound, cum))
+                out[ls] = {"buckets": buckets, "sum": h.sum, "count": h.count}
+            return out
+
     def reset(self) -> None:
         """Drop every series and declaration (test isolation)."""
         with self._lock:
@@ -276,6 +303,32 @@ class Histogram:
 
     def observe(self, value: float, labels: Labels = "") -> None:
         self.registry.observe(self.name, value, labels)
+
+
+def quantile_from_buckets(buckets, q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile over cumulative buckets
+    ([(le, cumulative_count), ...] as returned by histogram_series,
+    final bound +Inf): linear interpolation inside the bucket holding
+    rank q*count. Returns None for an empty histogram; observations in
+    the +Inf bucket clamp to the last finite bound (same convention as
+    PromQL — the histogram cannot say more than its widest bucket)."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in buckets:
+        if cum >= rank:
+            if math.isinf(bound):
+                return prev_bound
+            if cum == prev_cum:
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, cum
+    return prev_bound
 
 
 METRICS = Metrics()

@@ -62,8 +62,15 @@ def bh_partitioned(
 
         bdim, hdim = arg_dims[ref]
         b, h = at(bdim), at(hdim)
-        if b is not None and b == h:
-            b = None  # one mesh axis cannot appear twice
+
+        def _names(axis) -> set:
+            return set(axis) if isinstance(axis, tuple) else {axis}
+
+        # One mesh axis cannot appear twice in a sharding. The overlap
+        # check must flatten tuple specs: b="data" vs h=("data", "tensor")
+        # collides on "data" just as surely as b == h exactly.
+        if b is not None and h is not None and _names(b) & _names(h):
+            b = None
 
         # An axis is only usable if it divides EVERY dimension it would
         # shard, across all operands and results — q's heads and the

@@ -301,7 +301,15 @@ def _q4_axes(mesh, arg_shapes, block: int):
     xs, ps, ss = arg_shapes
     c_axis, n_axis = _spec_tuple(ps, 2)
     m_axis = _spec_tuple(xs, 2)[0]
-    if m_axis is not None and m_axis in (c_axis, n_axis):
+    # Overlap is per MESH AXIS NAME, not whole-spec-value equality: a
+    # tuple spec like ("data", "fsdp") on the contracting dim still
+    # claims "data", so a batch dim sharded plain "data" must drop out
+    # (one mesh axis cannot appear twice in a sharding).
+    used = set()
+    for ax in (c_axis, n_axis):
+        if ax is not None:
+            used.update(_axis_names(ax))
+    if m_axis is not None and set(_axis_names(m_axis)) & used:
         m_axis = None
     if c_axis is not None:
         tp = int(np_prod(mesh.shape[a] for a in _axis_names(c_axis)))

@@ -62,6 +62,21 @@ METRICS.histogram(
     "(paged layout only).",
     buckets=RATIO_BUCKETS,
 )
+METRICS.histogram(
+    "substratus_serve_phase_seconds",
+    "Wall time of one scheduler phase (seconds), labeled by phase: "
+    "broadcast (lockstep event sync, serve/multihost.py), admission "
+    "(queue -> slots, prefill included), prefill (device prefill inside "
+    "admission), sample (first-token sampling + host read), decode (the "
+    "batched decode/verify dispatch of one iteration).",
+)
+METRICS.describe(
+    "substratus_serve_first_compile_seconds",
+    "Wall time of the first decode iteration (executable compile "
+    "dominates; steady-state decode is substratus_serve_phase_seconds"
+    '{phase="decode"}).',
+    type="gauge",
+)
 
 
 @dataclass
@@ -375,6 +390,7 @@ class Engine:
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
         self._admitting: Optional[Request] = None
+        self._first_decode_done = False
 
         # Multi-host lockstep (serve/multihost.py). The sync'd request
         # list replaces the thread-safe queue as the scheduler's source:
@@ -702,8 +718,9 @@ class Engine:
             return False
         return True
 
-    def _admit(self):
-        """Fill free slots from the request queue (prefill + insert).
+    def _admit(self) -> int:
+        """Fill free slots from the request queue (prefill + insert);
+        returns how many requests boarded this iteration.
 
         Admission is capped per scheduler iteration so a burst of arrivals
         can't starve in-flight decodes: each loop admits a few prefills,
@@ -734,6 +751,7 @@ class Engine:
                     "substratus_serve_queue_wait_seconds",
                     time.perf_counter() - req.submit_ts,
                 )
+            t_prefill = time.perf_counter()
             with tracer.span(
                 "engine.prefill", parent=req.trace_ctx,
                 request_id=req.id, slot=slot,
@@ -743,6 +761,11 @@ class Engine:
                     ok = self._admit_paged(req, slot)
                 else:
                     ok = self._admit_dense(req, slot)
+            METRICS.observe(
+                "substratus_serve_phase_seconds",
+                time.perf_counter() - t_prefill,
+                {"phase": "prefill"},
+            )
             self._admitting = None
             if not ok:
                 # Pool dry even after eviction: hold the request at the
@@ -753,6 +776,7 @@ class Engine:
         self.stats["max_active"] = max(
             self.stats["max_active"], int(self.active.sum())
         )
+        return admitted
 
     def _admit_dense(self, req: Request, slot: int) -> bool:
         # Keep the newest tokens that fit the cache (minus one slot for
@@ -863,6 +887,7 @@ class Engine:
     def _finalize_admit(self, req: Request, slot: int, last_logits,
                         true_len: int) -> None:
         # Sample the first generated token from the prefill logits.
+        t_sample = time.perf_counter()
         first, key_out = self._sample1_fn(
             last_logits,
             self.key,
@@ -871,6 +896,11 @@ class Engine:
         )
         self.key = np.asarray(key_out)
         first_id = int(first[0])
+        METRICS.observe(
+            "substratus_serve_phase_seconds",
+            time.perf_counter() - t_sample,
+            {"phase": "sample"},
+        )
 
         self.slot_req[slot] = req
         self.slot_generated[slot] = 0
@@ -1190,7 +1220,17 @@ class Engine:
     def _loop(self):
         try:
             while self._sync_iterate():
-                self._admit()
+                t_admit = time.perf_counter()
+                if self._admit():
+                    # Only iterations that boarded someone observe the
+                    # admission phase — an idle engine polling its empty
+                    # queue at 500 Hz would otherwise flood the histogram
+                    # with ~0 s samples.
+                    METRICS.observe(
+                        "substratus_serve_phase_seconds",
+                        time.perf_counter() - t_admit,
+                        {"phase": "admission"},
+                    )
                 if not self.active.any():
                     # Lockstep mode pays a collective per iteration, so
                     # idle gangs tick slower (<=20ms first-token cost).
@@ -1205,10 +1245,30 @@ class Engine:
                         "substratus_serve_kv_page_utilization_ratio",
                         (self.n_pages - self.alloc.free_pages) / self.n_pages,
                     )
+                t_decode = time.perf_counter()
+                if not self._first_decode_done:
+                    # The first decode iteration is dominated by the
+                    # executable compile; record it separately so the
+                    # steady-state decode histogram stays unpolluted.
+                    with tracer.span("engine.first_compile") as span:
+                        if self.spec:
+                            self._spec_step()
+                        else:
+                            self._decode_step()
+                        dt = time.perf_counter() - t_decode
+                        span.set_attribute("seconds", round(dt, 6))
+                    self._first_decode_done = True
+                    METRICS.set("substratus_serve_first_compile_seconds", dt)
+                    continue
                 if self.spec:
                     self._spec_step()
                 else:
                     self._decode_step()
+                METRICS.observe(
+                    "substratus_serve_phase_seconds",
+                    time.perf_counter() - t_decode,
+                    {"phase": "decode"},
+                )
         except BaseException as e:  # propagate to waiting callers
             self.error = e
             if self.sync is not None and self.sync.leader:

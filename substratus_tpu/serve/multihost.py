@@ -35,9 +35,13 @@ once.
 from __future__ import annotations
 
 import json
+import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from substratus_tpu.observability.metrics import METRICS
 
 
 class NullSink:
@@ -62,7 +66,36 @@ def struct_pack_u32(n: int) -> bytes:
     return struct.pack("<I", n)
 
 
-class StepSync:
+class _TimedSync:
+    """Shared broadcast timing for every sync transport: wall time lands
+    in the shared registry (`substratus_serve_phase_seconds{phase=
+    "broadcast"}`) and the last few thousand `(payload_bytes, seconds)`
+    samples stay on `timings`, so the gang bench (tools/engine_bench.py
+    --gang) reports wall-time percentiles — including the bucket-padded
+    overflow path a >=8k-token admission takes — without scraping
+    /metrics mid-run."""
+
+    timings: "deque[tuple]"
+
+    def broadcast(self, payload: Optional[bytes]) -> bytes:
+        if self.num_processes == 1:
+            return payload or b""
+        t0 = time.perf_counter()
+        out = self._broadcast(payload)
+        dt = time.perf_counter() - t0
+        # Record the DELIVERED length (== payload on the leader), so
+        # follower-side samples carry real message sizes too.
+        self.timings.append((len(out), dt))
+        METRICS.observe(
+            "substratus_serve_phase_seconds", dt, {"phase": "broadcast"}
+        )
+        return out
+
+    def _broadcast(self, payload: Optional[bytes]) -> bytes:
+        raise NotImplementedError
+
+
+class StepSync(_TimedSync):
     """Per-iteration event broadcast for lockstep multi-host serving."""
 
     def __init__(self) -> None:
@@ -71,21 +104,20 @@ class StepSync:
         self.process_index = jax.process_index()
         self.num_processes = jax.process_count()
         self.leader = self.process_index == 0
+        self.timings = deque(maxlen=4096)
 
     # Inline buffer: 4-byte length prefix + payload. Sized so a typical
     # iteration (a few requests, cancels, or the idle heartbeat) is one
     # collective.
     INLINE = 1024
 
-    def broadcast(self, payload: Optional[bytes]) -> bytes:
+    def _broadcast(self, payload: Optional[bytes]) -> bytes:
         """Leader sends `payload`; every process returns it. The message
         rides one fixed-size collective (length embedded in the first 4
         bytes); only payloads overflowing the inline buffer pay a second,
         bucket-padded collective — every process derives the same
         collective count from the first buffer, so the gang stays in
         lockstep."""
-        if self.num_processes == 1:
-            return payload or b""
         from jax.experimental import multihost_utils
 
         payload = payload or b""
@@ -98,7 +130,10 @@ class StepSync:
                 payload[:cap], np.uint8
             )
         out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-        n = int(out[:4].view(np.uint32)[0])
+        # The header was packed little-endian (struct "<I"); read it back
+        # with an EXPLICIT little-endian dtype — a native-order view on a
+        # big-endian host would decode a garbage length and desync the gang.
+        n = int(out[:4].view(np.dtype("<u4"))[0])
         if n <= cap:
             return bytes(out[4 : 4 + n].tobytes())
         size = _bucket_bytes(n)
@@ -107,6 +142,84 @@ class StepSync:
             big[:n] = np.frombuffer(payload, np.uint8)
         out2 = np.asarray(multihost_utils.broadcast_one_to_all(big))
         return bytes(out2[:n].tobytes())
+
+
+class TcpSync(_TimedSync):
+    """Lockstep event broadcast over plain TCP (leader fans each
+    length-prefixed message out to every follower; followers block on
+    recv). The scheduler only ever sees the `broadcast` interface, so
+    this is a drop-in StepSync for environments whose backend has no
+    multi-process collectives — notably CPU jaxlib, where the gang bench
+    (tools/engine_bench.py --gang --transport tcp) still measures a real
+    2-process lockstep gang: identical mirrored schedulers, a real
+    inter-process hop per iteration, only the ICI transfer time missing.
+    Production multi-host serving stays on StepSync (the XLA collective
+    needs no extra network plumbing and rides the proven fabric)."""
+
+    def __init__(self, process_index: int, num_processes: int, port: int,
+                 host: str = "127.0.0.1", timeout: float = 120.0) -> None:
+        import socket
+
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.leader = process_index == 0
+        self.timings = deque(maxlen=4096)
+        if self.num_processes == 1:
+            self._conns: List[Any] = []
+            return
+        if self.leader:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((host, port))
+            srv.listen(num_processes - 1)
+            srv.settimeout(timeout)
+            self._conns = [
+                srv.accept()[0] for _ in range(num_processes - 1)
+            ]
+            srv.close()
+        else:
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    conn = socket.create_connection((host, port), timeout=5)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            conn.settimeout(timeout)
+            self._conns = [conn]
+        for c in self._conns:
+            c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _broadcast(self, payload: Optional[bytes]) -> bytes:
+        payload = payload or b""
+        if self.leader:
+            msg = struct_pack_u32(len(payload)) + payload
+            for c in self._conns:
+                c.sendall(msg)
+            return payload
+        conn = self._conns[0]
+
+        def recv_exact(n: int) -> bytes:
+            chunks = []
+            while n:
+                chunk = conn.recv(n)
+                if not chunk:
+                    raise ConnectionError("leader closed the sync stream")
+                chunks.append(chunk)
+                n -= len(chunk)
+            return b"".join(chunks)
+
+        n = int(np.frombuffer(recv_exact(4), np.dtype("<u4"))[0])
+        return recv_exact(n)
+
+    def close(self) -> None:
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 def encode_events(reqs: List[Any], cancels: List[int], stop: bool) -> bytes:

@@ -17,6 +17,7 @@ import json
 import logging
 import math
 import os
+import re
 import time
 import uuid
 from typing import Optional
@@ -545,6 +546,68 @@ def build_app(state: ServerState) -> web.Application:
         rows.sort(key=lambda r: r["age_s"], reverse=True)
         return web.json_response(
             {"inflight": rows, "queue_depth": eng.queue.qsize()}
+        )
+
+    @routes.get("/debug/perfz")
+    async def perfz(request: web.Request) -> web.Response:
+        """Performance flight recorder: the scheduler's phase-level
+        timing breakdown (admission / broadcast / prefill / decode /
+        sample), first-compile duration, request-latency quantiles, and
+        the engine's live counters — the 'where does an iteration's time
+        go' page, rendered from the shared registry with no scrape
+        pipeline required. Phases NEST (admission contains prefill
+        contains sample): they time named sections, not a partition."""
+        await _authorize_debug(request)
+        from substratus_tpu.observability.metrics import (
+            quantile_from_buckets,
+        )
+
+        _phase_re = re.compile(r'^phase="(.*)"$')
+
+        def family(name: str, key_label: str = "") -> dict:
+            out = {}
+            for ls, s in METRICS.histogram_series(name).items():
+                m = _phase_re.match(ls) if ls else None
+                key = m.group(1) if m else (ls or "all")
+                out[key] = {
+                    "count": s["count"],
+                    "sum_s": round(s["sum"], 6),
+                    "mean_s": (
+                        round(s["sum"] / s["count"], 6) if s["count"] else None
+                    ),
+                    **{
+                        f"p{int(q * 100)}_s": (
+                            None
+                            if (v := quantile_from_buckets(s["buckets"], q))
+                            is None
+                            else round(v, 6)
+                        )
+                        for q in (0.5, 0.9, 0.99)
+                    },
+                }
+            return out
+
+        eng = state.engine
+        return web.json_response(
+            {
+                "phases": family("substratus_serve_phase_seconds"),
+                "first_compile_seconds": METRICS.get(
+                    "substratus_serve_first_compile_seconds"
+                ),
+                "latencies": {
+                    short: family(f"substratus_serve_{short}_seconds")
+                    for short in ("ttft", "inter_token", "queue_wait")
+                },
+                "occupancy": family("substratus_serve_batch_occupancy_ratio"),
+                "train_phases": family("substratus_train_phase_seconds"),
+                "engine": {
+                    "active_slots": int(eng.active.sum()),
+                    "max_slots": eng.ec.max_batch,
+                    "queue_depth": eng.queue.qsize(),
+                    "kv_layout": "paged" if eng.paged else "dense",
+                    "stats": dict(eng.stats),
+                },
+            }
         )
 
     @routes.get("/debug/eventz")

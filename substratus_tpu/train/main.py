@@ -160,13 +160,32 @@ def main(argv=None) -> int:
         grad_accum_steps=int(p.get("grad_accum_steps", 1)),
     )
     trainer = Trainer(cfg, tc, mesh, params=params)
+    # Per-process dataset sharding is only sound when the global batch dim
+    # actually shards across processes: data/fsdp are the LEADING mesh
+    # axes (parallel/mesh.py), so each process owns a contiguous block of
+    # batch rows exactly when data*fsdp is a multiple of the process
+    # count. Otherwise (e.g. a tensor-only multi-host mesh, dp_total=1,
+    # nproc=2) the batch dim is replicated-or-uneven across hosts and
+    # per-process shards would SILENTLY diverge — every replica must see
+    # identical values, so fall back to every host loading the identical
+    # full batch instead.
+    shard_data = nproc > 1 and dp_total % nproc == 0
+    if nproc > 1 and not shard_data:
+        print(
+            f"per-process dataset sharding disabled: data*fsdp={dp_total} "
+            f"does not divide across {nproc} processes; every host loads "
+            "identical full batches",
+            flush=True,
+        )
     data = PackedDataset(
-        args.data, tokenizer, batch_size // nproc, seq_len,
+        args.data, tokenizer,
+        batch_size // nproc if shard_data else batch_size, seq_len,
         eos_id=getattr(tokenizer, "eos_id", 0),
         seed=tc.seed,
-        shard=jax.process_index(),
-        num_shards=nproc,
+        shard=jax.process_index() if shard_data else 0,
+        num_shards=nproc if shard_data else 1,
     )
+    batch_is_global = nproc > 1 and not shard_data
     print(
         f"training: {n_dev} devices, mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}, "
         f"steps={steps}, corpus={data.n_tokens} tokens, lora_rank={lora_rank}",
@@ -244,12 +263,14 @@ def main(argv=None) -> int:
             if prof_range and step == prof_range[0]:
                 jax.profiler.start_trace(os.path.join(args.out, "profile"))
                 tracing = True
+            # Phase splits (train/telemetry.py): data_load / step /
+            # checkpoint each timed separately, so a slow run triages to
+            # input pipeline vs device step vs checkpoint I/O.
+            t0 = time.perf_counter()
+            batch = next(data)
             t_step = time.perf_counter()
-            loss = trainer.train_step(next(data))
-            step_log.log_step(
-                step, float(loss), time.perf_counter() - t_step,
-                last=step == steps - 1,
-            )
+            loss = trainer.train_step(batch, batch_is_global=batch_is_global)
+            t_ckpt = time.perf_counter()
             if tracing and step == prof_range[1]:
                 jax.profiler.stop_trace()
                 tracing = False
@@ -260,6 +281,13 @@ def main(argv=None) -> int:
                 step + 1,
                 {"trainable": trainable, "opt_state": trainer.opt_state},
                 force=(step == steps - 1),
+            )
+            t_end = time.perf_counter()
+            step_log.log_step(
+                step, float(loss), t_ckpt - t_step,
+                last=step == steps - 1,
+                data_seconds=t_step - t0,
+                checkpoint_seconds=t_end - t_ckpt,
             )
     if tracing:
         jax.profiler.stop_trace()

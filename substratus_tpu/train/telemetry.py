@@ -40,6 +40,13 @@ METRICS.histogram(
     "device's peak FLOPs are known.",
     buckets=RATIO_BUCKETS,
 )
+METRICS.histogram(
+    "substratus_train_phase_seconds",
+    "Wall time of one train-loop phase (seconds), labeled by phase: "
+    "data_load (next batch from the dataset), step (the optimizer step, "
+    "device-synchronized), checkpoint (checkpoint save, 0 when the step "
+    "saved nothing).",
+)
 for _name, _help in (
     ("substratus_train_step", "Last completed optimizer step."),
     ("substratus_train_loss", "Loss at the last completed step."),
@@ -95,14 +102,36 @@ class StepLogger:
     def log_step(
         self, step: int, loss: float, step_seconds: float,
         last: bool = False,
+        data_seconds: Optional[float] = None,
+        checkpoint_seconds: Optional[float] = None,
     ) -> Optional[dict]:
         """Record one completed step. Histograms update every step; the
         JSON progress line is emitted every `log_every` steps (and on the
-        final step). Returns the emitted record, or None."""
+        final step). Returns the emitted record, or None.
+
+        data_seconds / checkpoint_seconds are the step's phase splits
+        (train/main.py times them around next(data) and maybe_save); when
+        given they land in substratus_train_phase_seconds and on the JSON
+        record, so a slow run triages to input pipeline vs device step vs
+        checkpoint I/O from the artifact alone."""
         step_seconds = max(step_seconds, 1e-9)
         tps = self.tokens_per_step / step_seconds
         METRICS.observe("substratus_train_step_seconds", step_seconds)
         METRICS.observe("substratus_train_tokens_per_second", tps)
+        METRICS.observe(
+            "substratus_train_phase_seconds", step_seconds,
+            {"phase": "step"},
+        )
+        if data_seconds is not None:
+            METRICS.observe(
+                "substratus_train_phase_seconds", data_seconds,
+                {"phase": "data_load"},
+            )
+        if checkpoint_seconds is not None:
+            METRICS.observe(
+                "substratus_train_phase_seconds", checkpoint_seconds,
+                {"phase": "checkpoint"},
+            )
         mfu = 0.0
         if self.peak_flops:
             mfu = (6.0 * self.n_params * self.tokens_per_step) / (
@@ -125,6 +154,10 @@ class StepLogger:
                 time.perf_counter() - self._t_start, 1
             ),
         }
+        if data_seconds is not None:
+            record["data_seconds"] = round(data_seconds, 4)
+        if checkpoint_seconds is not None:
+            record["checkpoint_seconds"] = round(checkpoint_seconds, 4)
         # Log/trace join: inside a span (train/main.py wraps the run in
         # `train.run`, itself parented from the spawning controller's
         # TRACEPARENT) every progress line names its trace — grep a slow

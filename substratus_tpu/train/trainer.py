@@ -39,6 +39,7 @@ from substratus_tpu.parallel.sharding import (
     sharding_tree,
 )
 from substratus_tpu.train import lora as lora_lib
+from substratus_tpu.utils.jaxcompat import ambient_mesh
 
 
 @dataclass(frozen=True)
@@ -312,15 +313,23 @@ class Trainer:
         donate = (0, 2)  # trainable + opt_state buffers
         return jax.jit(train_step, donate_argnums=donate)
 
-    def train_step(self, batch: Dict[str, jnp.ndarray]) -> float:
+    def train_step(
+        self, batch: Dict[str, jnp.ndarray], batch_is_global: bool = False
+    ) -> float:
         """batch: {"tokens": [B, S] int32, "weights": [B, S] 0/1}.
 
         Multi-process: B is the PER-PROCESS slice (global/N); the global
         batch assembles from every process's local rows via
         make_array_from_process_local_data, so no host ever materializes
-        (or needs to agree on) the whole batch."""
+        (or needs to agree on) the whole batch.
+
+        batch_is_global: every process passed the IDENTICAL full global
+        batch (train/main.py falls back to this when dp_total doesn't
+        divide across processes) — placement then slices each process's
+        addressable rows out of the full array instead of concatenating
+        per-process shards."""
         nproc = jax.process_count()
-        b = batch["tokens"].shape[0] * nproc
+        b = batch["tokens"].shape[0] * (1 if batch_is_global else nproc)
         dp = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         if b % dp:
             raise ValueError(
@@ -337,7 +346,10 @@ class Trainer:
         if nproc > 1:
             batch = jax.tree.map(
                 lambda x: jax.make_array_from_process_local_data(
-                    self.batch_sharding, np.asarray(x)
+                    self.batch_sharding, np.asarray(x),
+                    global_shape=(
+                        np.asarray(x).shape if batch_is_global else None
+                    ),
                 ),
                 batch,
             )
@@ -348,7 +360,7 @@ class Trainer:
         trainable = self.lora if self.lora is not None else self.params
         # Ambient mesh: the ring-attention path (cfg.attn_impl == "ring")
         # opens a shard_map over the "sequence" axis inside the jitted step.
-        with jax.set_mesh(self.mesh):
+        with ambient_mesh(self.mesh):
             trainable, self.opt_state, loss = self._train_step(
                 trainable, self.params if self.lora is not None else None,
                 self.opt_state, batch,

@@ -1,0 +1,63 @@
+"""Version-compat accessors for jax APIs that moved between releases.
+
+The repo targets current jax (`jax.shard_map`, `jax.set_mesh`), but CI
+images and operator laptops lag; these shims translate to the older
+spellings instead of AttributeError-ing whole subsystems. Each shim
+prefers the new API when present, so on current jax they are free.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def ambient_mesh(mesh):
+    """Ambient-mesh context manager: `jax.set_mesh` where it exists,
+    else the Mesh object's own context manager (the pre-set_mesh
+    spelling of the same thing). Code that opens a shard_map inside a
+    jitted step needs the mesh ambient either way."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh
+
+
+def pcast(x, axes, to="varying"):
+    """`jax.lax.pcast` (varying-axes typing, new jax) or identity: legacy
+    shard_map has no varying-type system — every value inside the manual
+    region is already device-varying, so the cast is a no-op there."""
+    fn = getattr(jax.lax, "pcast", None)
+    return fn(x, axes, to=to) if fn is not None else x
+
+
+def shard_map(f, *, in_specs, out_specs, axis_names=None, mesh=None):
+    """`jax.shard_map` (new: keyword-only, ambient mesh, `axis_names`
+    picking the manual axes) with a fallback onto the legacy
+    `jax.experimental.shard_map.shard_map` (positional mesh, every axis
+    manual unless listed in `auto`)."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = dict(in_specs=in_specs, out_specs=out_specs)
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        return native(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if mesh is None:
+        # The callers enter the mesh via trainer.ambient_mesh (the Mesh
+        # context manager on legacy jax), which is exactly where legacy
+        # thread resources record it.
+        from jax._src import mesh as mesh_lib
+
+        mesh = mesh_lib.thread_resources.env.physical_mesh
+        if mesh.empty:
+            raise ValueError(
+                "shard_map needs an ambient mesh (with ambient_mesh(m):) "
+                "or an explicit mesh= on this jax version"
+            )
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return legacy(
+        f, mesh, in_specs=in_specs, out_specs=out_specs,
+        auto=auto, check_rep=False,
+    )

@@ -182,6 +182,54 @@ def test_server_flow(env):
     assert srv["status"]["ready"] is True
 
 
+def test_server_single_host_replicas_fanout(env):
+    """`params.replicas: 2` on a single-host Server scales the Deployment
+    and the Service fans out across both pods (VERDICT weak #8): the
+    selector matches the replicated pod template's labels, and
+    status.ready tracks readyReplicas both up and down."""
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "srv2", "namespace": "default"},
+            "spec": {
+                "image": "img:3",
+                "model": {"name": "base"},
+                "params": {"replicas": 2},
+            },
+        }
+    )
+    mgr.run_until_idle()
+
+    dep = client.get("Deployment", "default", "srv2-server")
+    assert dep["spec"]["replicas"] == 2
+    # Endpoint fan-out: the Service selector must match the labels every
+    # replicated pod carries, so both pods back the one Service.
+    svc = client.get("Service", "default", "srv2-server")
+    tmpl_labels = dep["spec"]["template"]["metadata"]["labels"]
+    sel = svc["spec"]["selector"]
+    assert sel.items() <= tmpl_labels.items(), (sel, tmpl_labels)
+    assert dep["spec"]["selector"]["matchLabels"].items() <= tmpl_labels.items()
+
+    # Not ready until the pods are; then readyReplicas drives status.ready.
+    assert client.get("Server", "default", "srv2")["status"]["ready"] is False
+    client.mark_deployment_ready("default", "srv2-server")
+    dep = client.get("Deployment", "default", "srv2-server")
+    assert dep["status"]["readyReplicas"] == 2
+    mgr.run_until_idle()
+    assert client.get("Server", "default", "srv2")["status"]["ready"] is True
+
+    # Both replicas vanish (rollout/eviction): ready must drop back.
+    dep["status"] = {"readyReplicas": 0, "replicas": 2}
+    client.update_status(dep)
+    mgr.run_until_idle()
+    assert client.get("Server", "default", "srv2")["status"]["ready"] is False
+
+
 def test_server_multihost_tpu_serving_gang(env):
     """A Server asking for a multi-host slice (the examples/llama2-70b
     v5e-16 shape) must become a lockstep serving gang — JobSet +

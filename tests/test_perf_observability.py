@@ -1,0 +1,358 @@
+"""Performance-observability subsystem (PR 3).
+
+Pins the dual-metric capture contract end to end: one hw_session smoke
+run emits BOTH BASELINE primary metrics as robust single-line JSON, the
+gang bench measures a real 2-process lockstep gang, phase-level timings
+land in the shared registry and surface on /debug/perfz, and the
+bench_compare regression gate actually gates.
+"""
+import asyncio
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from substratus_tpu.observability.metrics import (
+    METRICS,
+    quantile_from_buckets,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_TRAIN = os.path.join(REPO, "tools", "bench_train.py")
+BENCH_COMPARE = os.path.join(REPO, "hack", "bench_compare.py")
+
+
+# --- bench_train robustness contract ----------------------------------------
+
+def test_bench_train_failure_json_contract():
+    """A wedged tunnel must still yield one parseable JSON line, exit 0,
+    and carry the bench.py-style diagnostics (the robustness contract of
+    the SECOND primary metric mirrors the first's)."""
+    env = dict(os.environ)
+    env["SUBSTRATUS_BENCH_SIM_WEDGE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, BENCH_TRAIN, "--probe-timeout", "3",
+         "--probe-budget", "10"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"].endswith("_finetune_step_time")
+    assert out["unit"] == "ms/step"
+    assert out["value"] is None
+    assert "hang" in out["error"]
+    attempts = out["diagnostics"]["probe_attempts"]
+    assert attempts and all(a["outcome"] == "hang" for a in attempts)
+
+
+def test_bench_train_reads_example_yaml_shape():
+    """batch/seq/lora_rank default to the 7B finetune example CR — the
+    bench measures the exact workload the Model CR runs."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_train
+    finally:
+        sys.path.pop(0)
+    d = bench_train.example_defaults()
+    # Must agree with examples/llama2-7b/finetuned-model.yaml.
+    assert d == {"batch_size": 8, "seq_len": 1024, "lora_rank": 16}
+
+
+# --- one session, both primary metrics (acceptance criterion) ---------------
+
+def test_hw_session_smoke_emits_both_primary_metrics(tmp_path):
+    """`bash tools/hw_session.sh smoke` — the CPU-scaled end-to-end proof
+    that ONE session captures serve tok/s/chip AND LoRA finetune
+    step-time (plus the lockstep gang comparison), each as one valid
+    JSON line with a real value."""
+    env = dict(os.environ)
+    env["HW_OUT"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "hw_session.sh"), "smoke"],
+        capture_output=True, text=True, timeout=720, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+
+    def capture_of(log_name):
+        text = (tmp_path / f"{log_name}.log").read_text()
+        lines = [ln for ln in text.splitlines() if '"metric"' in ln]
+        assert lines, f"{log_name}: no capture line\n{text[-1500:]}"
+        rec = json.loads(lines[-1])
+        # Validate through the same gate CI uses.
+        chk = subprocess.run(
+            [sys.executable, BENCH_COMPARE, "--validate", "-"],
+            input=json.dumps(rec), capture_output=True, text=True,
+        )
+        assert chk.returncode == 0, chk.stderr
+        return rec
+
+    serve = capture_of("bench_auto")
+    train = capture_of("bench_train")
+    gang = capture_of("engine_gang")
+    assert serve["metric"].endswith("_decode_throughput_per_chip")
+    assert serve["unit"] == "tokens/sec/chip" and serve["value"] > 0
+    assert train["metric"].endswith("_finetune_step_time")
+    assert train["unit"] == "ms/step" and train["value"] > 0
+    assert train["tokens_per_second"] > 0
+    # The gang leg measured a real 2-process lockstep run: broadcast
+    # percentiles exist, and the >=8k-token admission broadcast overflowed
+    # the 1 KB inline buffer (VERDICT weak #6).
+    assert gang["nprocs"] == 2
+    assert gang["broadcast_ms"]["count"] > 0
+    assert gang["broadcast_ms"]["p50"] >= 0
+    assert gang["admission"]["prompt_tokens"] >= 8192
+    assert gang["admission"]["broadcast_bytes"] > 1024
+    assert gang["ttft_delta_ms"] is not None
+    assert gang["single_value"] > 0
+
+
+# --- bench_compare regression gate ------------------------------------------
+
+def test_bench_compare_self_test_and_gate(tmp_path):
+    """The synthetic-regression self-test passes, a 20% regression against
+    a real history file fails the CLI, and an unchanged capture passes."""
+    r = subprocess.run(
+        [sys.executable, BENCH_COMPARE, "--self-test"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    hist = tmp_path / "BENCH_r90.json"
+    hist.write_text(json.dumps({
+        "n": 90, "rc": 0,
+        "parsed": {"metric": "m_throughput", "value": 100.0,
+                   "unit": "tokens/sec/chip"},
+    }))
+
+    def gate(value):
+        return subprocess.run(
+            [sys.executable, BENCH_COMPARE, "--new", "-",
+             "--history", str(hist)],
+            input=json.dumps({"metric": "m_throughput", "value": value,
+                              "unit": "tokens/sec/chip"}),
+            capture_output=True, text=True,
+        )
+
+    bad = gate(80.0)
+    assert bad.returncode == 1 and "regression" in bad.stderr
+    good = gate(100.0)
+    assert good.returncode == 0, good.stderr
+
+
+def test_bench_compare_accepts_historical_trajectory():
+    """Every recorded BENCH_r0*.json (driver wrapper shape, null-value
+    rounds included) must load cleanly — the gate can't reject its own
+    history (acceptance criterion)."""
+    sys.path.insert(0, os.path.join(REPO, "hack"))
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+    history, problems = bench_compare.load_history(["BENCH_r0*.json"])
+    assert problems == [], problems
+    # All five recorded rounds are null captures so far; once a real
+    # value lands it must become comparable.
+    assert isinstance(history, dict)
+
+
+# --- quantile helper --------------------------------------------------------
+
+def test_quantile_from_buckets_interpolates():
+    # 10 obs <= 0.1, 10 more <= 1.0 (cumulative), none beyond.
+    buckets = [(0.1, 10), (1.0, 20), (float("inf"), 20)]
+    assert quantile_from_buckets(buckets, 0.5) == pytest.approx(0.1)
+    assert quantile_from_buckets(buckets, 0.75) == pytest.approx(0.55)
+    assert quantile_from_buckets(buckets, 1.0) == pytest.approx(1.0)
+    # +Inf bucket clamps to the widest finite bound.
+    assert quantile_from_buckets(
+        [(0.1, 0), (float("inf"), 5)], 0.9
+    ) == pytest.approx(0.1)
+    assert quantile_from_buckets([], 0.5) is None
+    assert quantile_from_buckets([(0.1, 0), (float("inf"), 0)], 0.5) is None
+
+
+# --- TcpSync lockstep transport ---------------------------------------------
+
+def test_tcp_sync_broadcast_roundtrip():
+    """Leader/follower TcpSync: short and >1KB payloads arrive intact,
+    both sides record (bytes, seconds) timing samples, and the follower
+    sees the delivered length."""
+    import socket
+
+    from substratus_tpu.serve.multihost import TcpSync
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    payloads = [b"tick", b"x" * 40_000, b""]
+    got = []
+
+    def follower():
+        sync = TcpSync(1, 2, port)
+        for _ in payloads:
+            got.append(sync.broadcast(None))
+        sync.close()
+
+    t = threading.Thread(target=follower)
+    t.start()
+    leader = TcpSync(0, 2, port)
+    for p in payloads:
+        assert leader.broadcast(p) == p
+    t.join(timeout=30)
+    assert not t.is_alive()
+    leader.close()
+    assert got == payloads
+    # Both sides' timing samples carry the real delivered sizes.
+    assert [b for b, _ in leader.timings] == [len(p) for p in payloads]
+
+
+def test_step_sync_header_is_little_endian():
+    """The broadcast length header is packed '<I' and must be read back
+    with an explicit little-endian dtype — a native-order view would
+    desync the gang on big-endian hosts (satellite fix)."""
+    import numpy as np
+
+    from substratus_tpu.serve.multihost import struct_pack_u32
+
+    n = 0x01020304
+    buf = np.frombuffer(struct_pack_u32(n), np.uint8)
+    assert int(buf.view(np.dtype("<u4"))[0]) == n
+    # The buggy read: native order happens to agree on LE hosts but the
+    # explicit dtype is what the code must use (see StepSync._broadcast).
+    assert int(np.frombuffer(struct_pack_u32(1024), np.dtype("<u4"))[0]) == 1024
+
+
+# --- engine phase timing + /debug/perfz -------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params,
+        EngineConfig(max_batch=4, max_seq_len=64, eos_token_id=257),
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_phase_metrics_and_first_compile(engine):
+    engine.generate([256, 5, 6, 7], max_tokens=8, temperature=0.0)
+    text = METRICS.render()
+    assert "# TYPE substratus_serve_phase_seconds histogram" in text
+    for phase in ("admission", "prefill", "sample", "decode"):
+        assert re.search(
+            rf'substratus_serve_phase_seconds_count\{{phase="{phase}"\}} '
+            r"[1-9]", text
+        ), f"phase {phase} not observed\n"
+    first = METRICS.get("substratus_serve_first_compile_seconds")
+    assert first is not None and first > 0
+    # The compile iteration is excluded from the steady-state decode
+    # histogram (first_compile >> any single decode step on tiny).
+    series = METRICS.histogram_series("substratus_serve_phase_seconds")
+    decode = series['phase="decode"']
+    assert decode["count"] >= 1
+    # first-compile recorded a span too
+    from substratus_tpu.observability.tracing import tracer
+
+    names = [s["name"] for s in tracer.finished()]
+    assert "engine.first_compile" in names
+
+
+def test_perfz_endpoint_shape(engine):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.serve.server import ServerState, build_app
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    state = ServerState(engine, ByteTokenizer(), "tiny")
+
+    async def go():
+        app = build_app(state)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "hello", "max_tokens": 6,
+                      "temperature": 0.0},
+            )
+            assert r.status == 200
+            r = await client.get("/debug/perfz")
+            assert r.status == 200
+            return await r.json()
+
+    doc = asyncio.run(go())
+    for phase in ("prefill", "sample", "decode"):
+        stats = doc["phases"][phase]
+        assert stats["count"] >= 1
+        assert stats["p50_s"] is not None and stats["p50_s"] >= 0
+        assert stats["mean_s"] >= 0
+    assert doc["first_compile_seconds"] > 0
+    assert doc["latencies"]["ttft"]["all"]["count"] >= 1
+    assert doc["engine"]["max_slots"] == 4
+    assert doc["engine"]["kv_layout"] in ("paged", "dense")
+    assert "stats" in doc["engine"]
+
+
+def test_train_phase_splits_in_record_and_registry():
+    from substratus_tpu.train.telemetry import StepLogger
+
+    before = METRICS.histogram_series("substratus_train_phase_seconds")
+    n_before = sum(s["count"] for s in before.values()) if before else 0
+    lines = []
+    sl = StepLogger(n_params=1000, tokens_per_step=128, emit=lines.append)
+    rec = sl.log_step(
+        0, loss=1.0, step_seconds=0.2, last=True,
+        data_seconds=0.05, checkpoint_seconds=0.01,
+    )
+    assert rec["data_seconds"] == 0.05
+    assert rec["checkpoint_seconds"] == 0.01
+    assert json.loads(lines[-1])["data_seconds"] == 0.05
+    after = METRICS.histogram_series("substratus_train_phase_seconds")
+    assert sum(s["count"] for s in after.values()) == n_before + 3
+    assert 'phase="data_load"' in after and 'phase="checkpoint"' in after
+
+
+# --- satellite: q4 tuple-spec axis overlap ----------------------------------
+
+def test_q4_axes_tuple_spec_overlap(mesh8):
+    """A contracting dim sharded with a TUPLE spec (("data","fsdp")) must
+    knock a plain "data" batch spec off the m axis — membership is per
+    mesh-axis name, not whole-value equality (satellite fix)."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from substratus_tpu.ops.quant4 import _q4_axes
+
+    mesh = mesh8
+    # C/block must divide the 4-way ("data","fsdp") contracting shards so
+    # the row-parallel path stays live and the overlap check is what's
+    # under test.
+    C, N, block = 512, 128, 128
+
+    def struct(shape, spec):
+        return jax.ShapeDtypeStruct(
+            shape, jnp.float32, sharding=NamedSharding(mesh, spec)
+        )
+
+    xs = struct((8, C), P("data", None))
+    ps = struct((C, N), P(("data", "fsdp"), None))
+    ss = struct((C // block, N), P())
+    m, c, n = _q4_axes(mesh, (xs, ps, ss), block)
+    assert m is None  # "data" already claimed by the contracting axis
+    # Disjoint batch axis survives.
+    xs2 = struct((8, C), P("tensor", None))
+    m2, _, _ = _q4_axes(mesh, (xs2, ps, ss), block)
+    assert m2 == "tensor"

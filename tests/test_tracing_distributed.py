@@ -485,7 +485,7 @@ def test_debug_endpoints_auth_gated(engine):
         _, client = _client_ctx(engine, authorizer=authz)
         async with client:
             for path in ("/debug/tracez", "/debug/requestz",
-                         "/debug/eventz"):
+                         "/debug/eventz", "/debug/perfz"):
                 r = await client.get(path)
                 assert r.status == 401, path
                 assert r.headers.get("WWW-Authenticate") == "Bearer"
@@ -509,7 +509,7 @@ def test_debug_endpoints_open_without_authorizer(engine):
         _, client = _client_ctx(engine)
         async with client:
             for path in ("/debug/tracez", "/debug/requestz",
-                         "/debug/eventz"):
+                         "/debug/eventz", "/debug/perfz"):
                 r = await client.get(path)
                 assert r.status == 200, path
 

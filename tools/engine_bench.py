@@ -5,16 +5,361 @@ bench.py's raw decode-step roofline.
 
     python tools/engine_bench.py [--config llama2-7b] [--requests 64]
         [--prompt-len 128] [--max-tokens 64] [--batch 24]
+
+Gang mode (--gang 2) measures the multi-host lockstep control plane
+(serve/multihost.py) against the single-process engine on the SAME mesh
+shape: it spawns a jax.distributed gang of this script, runs the load on
+the leader, then runs an identical single-process engine over the same
+device count, and prints ONE JSON line with aggregate tok/s for both,
+the TTFT delta, and per-iteration broadcast wall-time percentiles from
+StepSync.timings. `--long-admission N` adds a prompt of N tokens whose
+JSON-encoded admission broadcast overflows the 1 KB inline buffer — the
+two-collective path an >=8k-token prompt always takes — and reports that
+broadcast's size and wall time separately.
+
+On CPU this is the measured stand-in for the pending hardware session
+(docs/performance.md "Lockstep control-plane overhead"): the mechanism
+cost — events serialized, N-byte collective, mirrored scheduler — is
+real on any backend; only the ICI transfer time needs the chip.
 """
 import argparse
+import json
+import os
+import subprocess
 import sys
 import threading
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> int:
+def _percentiles_ms(samples) -> dict:
+    """{count, p50, p90, p99, max} in milliseconds from raw seconds."""
+    if not samples:
+        return {"count": 0}
+    xs = sorted(samples)
+
+    def pick(q):
+        return xs[min(len(xs) - 1, int(q * len(xs)))] * 1e3
+
+    return {
+        "count": len(xs),
+        "p50": round(pick(0.50), 3),
+        "p90": round(pick(0.90), 3),
+        "p99": round(pick(0.99), 3),
+        "max": round(xs[-1] * 1e3, 3),
+    }
+
+
+def build_prompts(a, cfg):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    if a.repetitive:
+        # Repeated n-grams: the prompt-lookup proposer's best case
+        # (summarization/RAG-shaped workloads).
+        gram = rng.integers(10, cfg.vocab_size - 1, 8).tolist()
+        reps = -(-a.prompt_len // len(gram))
+        return [(gram * reps)[: a.prompt_len] for _ in range(a.requests)]
+    return [
+        rng.integers(10, cfg.vocab_size - 1, a.prompt_len).tolist()
+        for _ in range(a.requests)
+    ]
+
+
+def run_load(engine, prompts, max_tokens):
+    """Run all prompts concurrently; returns (gen_tokens, wall_s,
+    ttft_s list) with TTFT measured client-side (submit -> first token),
+    the same boundary an HTTP caller would see."""
+    from substratus_tpu.serve.engine import Request
+
+    done = []
+    ttfts = []
+    lock = threading.Lock()
+
+    def run_one(p):
+        req = engine.submit(Request(list(p), max_tokens=max_tokens,
+                                    temperature=0.0))
+        t0 = time.perf_counter()
+        n = 0
+        first = None
+        while True:
+            tok = req.out.get(timeout=600)
+            if tok is None:
+                break
+            if first is None:
+                first = time.perf_counter() - t0
+            n += 1
+        with lock:
+            done.append(n)
+            if first is not None:
+                ttfts.append(first)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_one, args=(p,)) for p in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(done), time.perf_counter() - t0, ttfts
+
+
+def make_engine(a, mesh=None, sync=None):
+    """Config + random params + Engine, honoring the CLI knobs (shared by
+    the single-process path and every gang worker — 'same config' is a
+    code path, not a convention)."""
+    import jax
+
+    from bench import random_quantized_params
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS[a.config]
+    if a.config == "tiny":
+        # The tiny test config needs f32 + a spare token id usable as a
+        # never-emitted EOS (same setup tests/test_multihost_serving.py
+        # uses); random-weight generations would otherwise stop on
+        # accidental EOS hits and measure nothing.
+        import jax.numpy as jnp
+
+        cfg = cfg.replace(vocab_size=258, dtype=jnp.float32)
+    if a.decode_impl != "xla":
+        # The Pallas/fused decode kernels live on the dense slot-cache
+        # path; the paged decode never consults decode_attn_impl — same
+        # policy as serve.main.resolve_kv_layout, enforced so the
+        # printed metric is never mislabeled.
+        if a.kv_layout == "paged":
+            raise SystemExit(
+                f"--decode-impl {a.decode_impl} requires --kv-layout dense"
+            )
+        a.kv_layout = "dense"
+        cfg = cfg.replace(decode_attn_impl=a.decode_impl)
+    if a.quantize == "none":
+        params = llama.init_params(cfg, jax.random.key(0))
+    else:
+        params = jax.jit(
+            lambda k: random_quantized_params(cfg, k, a.quantize)
+        )(jax.random.key(0))
+    jax.tree.leaves(params)[0].block_until_ready()
+
+    ec = EngineConfig(
+        max_batch=a.batch,
+        max_seq_len=min(a.max_seq_len, cfg.max_seq_len),
+        max_prefill_len=min(256, a.max_seq_len),
+        kv_cache_dtype="model" if a.config == "tiny" else a.kv_dtype,
+        kv_layout=a.kv_layout,
+        spec_k=a.spec_k,
+        eos_token_id=257 if a.config == "tiny" else 2,
+    )
+    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync)
+    engine.start()
+    return cfg, engine
+
+
+def measure(a, mesh=None, sync=None) -> dict:
+    """One engine, the full load; returns the result record (leader-side
+    fields only meaningful on the process that owns the requests)."""
+    cfg, engine = make_engine(a, mesh=mesh, sync=sync)
+    prompts = build_prompts(a, cfg)
+
+    # Warm the executables (prefill bucket + decode) outside the clock.
+    engine.generate(prompts[0][:16], max_tokens=2, temperature=0.0)
+
+    admission = None
+    if a.long_admission:
+        # The >=8k-token admission leg: ONE long prompt, timed separately
+        # — its JSON-encoded event broadcast must overflow StepSync's
+        # 1 KB inline buffer onto the bucket-padded second collective.
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        long_prompt = rng.integers(
+            10, cfg.vocab_size - 1, a.long_admission
+        ).tolist()
+        before = len(engine.sync.timings) if engine.sync else 0
+        t0 = time.perf_counter()
+        engine.generate(long_prompt, max_tokens=2, temperature=0.0)
+        wall = time.perf_counter() - t0
+        admission = {
+            "prompt_tokens": a.long_admission,
+            "wall_ms": round(wall * 1e3, 3),
+        }
+        if engine.sync:
+            # The admission-carrying broadcast is the biggest message in
+            # the window this request spans.
+            window = list(engine.sync.timings)[before:]
+            if window:
+                nbytes, secs = max(window, key=lambda t: t[0])
+                admission["broadcast_bytes"] = nbytes
+                admission["broadcast_ms"] = round(secs * 1e3, 3)
+
+    gen_tokens, wall_s, ttfts = run_load(engine, prompts, a.max_tokens)
+    out = {
+        "gen_tokens": gen_tokens,
+        "wall_s": round(wall_s, 3),
+        "gen_tok_s": round(gen_tokens / wall_s, 1),
+        "total_tok_s": round(
+            (gen_tokens + a.requests * a.prompt_len) / wall_s, 1
+        ),
+        "ttft_ms": _percentiles_ms(ttfts),
+        "admission": admission,
+    }
+    if a.spec_k:
+        s = engine.stats
+        out["spec"] = {
+            "spec_k": a.spec_k,
+            "acceptance": round(
+                s["spec_accepted"] / s["spec_proposed"], 3
+            ) if s["spec_proposed"] else 0.0,
+            "verify_passes": s["verify_passes"],
+        }
+    if engine.sync is not None:
+        out["broadcast_ms"] = _percentiles_ms(
+            [secs for _, secs in engine.sync.timings]
+        )
+        out["broadcast_max_bytes"] = max(
+            (b for b, _ in engine.sync.timings), default=0
+        )
+    engine.stop()
+    return out
+
+
+def gang_worker(a) -> int:
+    """One process of the lockstep gang (leader owns the load)."""
+    if a.transport == "tcp":
+        # No shared XLA world: every process computes a full replica on
+        # its own devices, mirrored by the lockstep scheduler over a TCP
+        # event stream (serve/multihost.py TcpSync). The control plane —
+        # serialization, a real inter-process hop per iteration, the
+        # mirrored scheduler — is identical to production; only the
+        # sharded math and ICI transfer need the XLA transport.
+        from substratus_tpu.serve.multihost import TcpSync
+
+        mesh = None
+        sync = TcpSync(a.pid, a.nprocs, a.sync_port)
+    else:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=a.coord,
+            num_processes=a.nprocs,
+            process_id=a.pid,
+        )
+        from substratus_tpu.parallel.mesh import build_mesh
+        from substratus_tpu.serve.multihost import StepSync
+
+        # data spans the gang, tensor spans each process's local devices
+        # — the shape tests/test_multihost_serving.py proves token-exact.
+        mesh = build_mesh(data=a.nprocs, tensor=-1)
+        sync = StepSync()
+    if sync.leader:
+        result = measure(a, mesh=mesh, sync=sync)
+        result["leader"] = True
+    else:
+        cfg, engine = make_engine(a, mesh=mesh, sync=sync)
+        engine._thread.join(timeout=3600)
+        result = {
+            "leader": False,
+            "stopped": not engine._thread.is_alive(),
+            "error": repr(engine.error) if engine.error else None,
+            "broadcast_ms": _percentiles_ms(
+                [secs for _, secs in sync.timings]
+            ),
+        }
+    with open(a.out, "w") as f:
+        json.dump(result, f)
+    print("gang worker done", a.pid, flush=True)
+    return 0
+
+
+def run_gang(a, base_args) -> dict:
+    """Spawn the N-process gang of this script, return the leader's
+    record (follower clean-exit asserted)."""
+    import socket
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        sync_port = s.getsockname()[1]
+    env = dict(os.environ)
+    # Virtual CPU devices per process (ignored on real accelerators,
+    # where each host's local chips are its devices).
+    if env.get("JAX_PLATFORMS", "") == "cpu":
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={a.devs_per_proc}"
+        )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    tmp = tempfile.mkdtemp(prefix="engine_bench_gang_")
+    procs, outs = [], []
+    for pid in range(a.gang):
+        out = os.path.join(tmp, f"gang{pid}.json")
+        outs.append(out)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__), *base_args,
+                    "--gang-worker", "--pid", str(pid),
+                    "--nprocs", str(a.gang),
+                    "--coord", f"127.0.0.1:{port}",
+                    "--sync-port", str(sync_port), "--out", out,
+                ],
+                env=env, stdout=sys.stderr, stderr=subprocess.STDOUT,
+            )
+        )
+    results = []
+    try:
+        for p, out in zip(procs, outs):
+            rc = p.wait(timeout=a.gang_timeout)
+            if rc != 0:
+                raise SystemExit(f"gang worker failed rc={rc}")
+            with open(out) as f:
+                results.append(json.load(f))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    leader = next(r for r in results if r["leader"])
+    for r in results:
+        if not r["leader"]:
+            assert r["stopped"] and not r["error"], r
+    return leader
+
+
+def run_single_same_shape(a, base_args) -> dict:
+    """The single-process comparison engine over the SAME device count
+    and mesh shape (so the delta isolates the lockstep control plane,
+    not a different parallel layout). Runs as a subprocess because the
+    parent must not initialize a jax backend before spawning workers."""
+    env = dict(os.environ)
+    if a.transport == "tcp":
+        # TCP gang processes each hold a full replica on their own
+        # devices — the fair single-process comparison is one engine
+        # with the same per-process resources, no mesh.
+        n = a.devs_per_proc
+        extra = []
+    else:
+        n = a.gang * a.devs_per_proc
+        extra = ["--mesh", f"data={a.gang},tensor=-1"]
+    if env.get("JAX_PLATFORMS", "") == "cpu":
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.abspath(__file__), *base_args,
+            *extra, "--json-only",
+        ],
+        env=env, capture_output=True, text=True, timeout=a.gang_timeout,
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise SystemExit(f"single-process comparison failed rc={proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="llama2-7b")
     ap.add_argument("--requests", type=int, default=64)
@@ -24,8 +369,9 @@ def main() -> int:
     ap.add_argument("--max-seq-len", type=int, default=512)
     ap.add_argument("--kv-dtype", default="int8", choices=["int8", "model"])
     ap.add_argument(
-        "--quantize", default="int8", choices=["int8", "int4"],
-        help="weight quantization for the random params",
+        "--quantize", default="int8", choices=["int8", "int4", "none"],
+        help="weight quantization for the random params (none = the "
+             "model dtype, what the tiny smoke config uses)",
     )
     ap.add_argument(
         "--kv-layout", default="auto", choices=["auto", "paged", "dense"]
@@ -42,7 +388,82 @@ def main() -> int:
         "--repetitive", action="store_true",
         help="prompts made of repeated n-grams so lookup speculation hits",
     )
-    a = ap.parse_args()
+    ap.add_argument(
+        "--gang", type=int, default=0,
+        help="N-process lockstep gang vs a single engine of the same "
+             "mesh shape; prints the combined comparison JSON",
+    )
+    ap.add_argument(
+        "--long-admission", type=int, default=0,
+        help="extra leg: one prompt of this many tokens, its admission "
+             "broadcast (JSON-encoded prompt) timed separately — use "
+             ">=8192 to exercise the overflow collective",
+    )
+    ap.add_argument(
+        "--devs-per-proc", type=int, default=2,
+        help="virtual CPU devices per gang process (CPU runs only)",
+    )
+    ap.add_argument(
+        "--transport", default="xla", choices=["xla", "tcp"],
+        help="gang event transport: xla = the production "
+             "multihost_utils collective (needs a backend with "
+             "multi-process support); tcp = TcpSync full-replica gang "
+             "(works on any backend, incl. CPU jaxlib without "
+             "multi-process collectives)",
+    )
+    ap.add_argument("--gang-timeout", type=float, default=1200.0)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="CPU-scaled CI smoke: tiny config, small load",
+    )
+    ap.add_argument(
+        "--mesh", default="",
+        help="mesh spec 'data=2,tensor=-1' for the single-process engine "
+             "(internal: the gang's same-shape comparison)",
+    )
+    ap.add_argument(
+        "--json-only", action="store_true",
+        help="print only the raw result record (internal)",
+    )
+    # gang-worker internals
+    ap.add_argument("--gang-worker", action="store_true")
+    ap.add_argument("--pid", type=int, default=0)
+    ap.add_argument("--nprocs", type=int, default=0)
+    ap.add_argument("--coord", default="")
+    ap.add_argument("--sync-port", type=int, default=0)
+    ap.add_argument("--out", default="")
+    a = ap.parse_args(argv)
+    if a.smoke:
+        a.config = "tiny"
+        a.quantize = "none"
+        a.requests = min(a.requests, 6)
+        a.prompt_len = min(a.prompt_len, 16)
+        a.max_tokens = min(a.max_tokens, 8)
+        a.batch = min(a.batch, 4)
+        a.max_seq_len = min(a.max_seq_len, 128)
+    return a
+
+
+# Args every sub-invocation must inherit (everything but the mode flags).
+def passthrough_args(a) -> list:
+    out = [
+        "--config", a.config, "--requests", str(a.requests),
+        "--prompt-len", str(a.prompt_len), "--max-tokens",
+        str(a.max_tokens), "--batch", str(a.batch),
+        "--max-seq-len", str(a.max_seq_len), "--kv-dtype", a.kv_dtype,
+        "--quantize", a.quantize, "--kv-layout", a.kv_layout,
+        "--decode-impl", a.decode_impl, "--spec-k", str(a.spec_k),
+        "--devs-per-proc", str(a.devs_per_proc),
+        "--long-admission", str(a.long_admission),
+        "--transport", a.transport,
+    ]
+    if a.repetitive:
+        out.append("--repetitive")
+    return out
+
+
+def main() -> int:
+    a = parse_args()
 
     # Honor an explicit JAX_PLATFORMS=cpu even under an injected
     # accelerator plugin whose tunnel may hang (utils/jaxenv.py).
@@ -50,99 +471,79 @@ def main() -> int:
 
     honor_requested_platform()
 
-    import jax
-    import numpy as np
+    if a.gang_worker:
+        return gang_worker(a)
 
-    from bench import random_quantized_params
-    from substratus_tpu.models import llama
-    from substratus_tpu.serve.engine import Engine, EngineConfig
+    if a.gang:
+        base = passthrough_args(a)
+        leader = run_gang(a, base)
+        single = run_single_same_shape(a, base)
+        ttft_gang = leader["ttft_ms"].get("p50")
+        ttft_single = single["ttft_ms"].get("p50")
+        record = {
+            "metric": f"{a.config.replace('-', '_')}_engine_gang_throughput",
+            "value": leader["gen_tok_s"],
+            "unit": "gen_tokens/sec",
+            "nprocs": a.gang,
+            "devs_per_proc": a.devs_per_proc,
+            "transport": a.transport,
+            "single_value": single["gen_tok_s"],
+            "gang_vs_single": (
+                round(leader["gen_tok_s"] / single["gen_tok_s"], 3)
+                if single["gen_tok_s"] else None
+            ),
+            "ttft_p50_ms": ttft_gang,
+            "ttft_p50_ms_single": ttft_single,
+            "ttft_delta_ms": (
+                round(ttft_gang - ttft_single, 3)
+                if ttft_gang is not None and ttft_single is not None
+                else None
+            ),
+            "broadcast_ms": leader.get("broadcast_ms", {}),
+            "admission": leader.get("admission"),
+            "requests": a.requests,
+            "quantize": a.quantize,
+            "kv_layout": a.kv_layout,
+            "decode_impl": a.decode_impl,
+            "wall_s": leader["wall_s"],
+        }
+        print(json.dumps(record))
+        return 0
 
-    cfg = llama.CONFIGS[a.config]
-    if a.decode_impl != "xla":
-        # The Pallas/fused decode kernels live on the dense slot-cache
-        # path; the paged decode never consults decode_attn_impl — same
-        # policy as serve.main.resolve_kv_layout, enforced so the
-        # printed metric is never mislabeled.
-        if a.kv_layout == "paged":
-            raise SystemExit(
-                f"--decode-impl {a.decode_impl} requires --kv-layout dense"
-            )
-        a.kv_layout = "dense"
-        cfg = cfg.replace(decode_attn_impl=a.decode_impl)
-    params = jax.jit(
-        lambda k: random_quantized_params(cfg, k, a.quantize)
-    )(jax.random.key(0))
-    jax.tree.leaves(params)[0].block_until_ready()
+    mesh = None
+    if a.mesh:
+        from substratus_tpu.parallel.mesh import build_mesh
 
-    ec = EngineConfig(
-        max_batch=a.batch,
-        max_seq_len=a.max_seq_len,
-        max_prefill_len=min(256, a.max_seq_len),
-        kv_cache_dtype=a.kv_dtype,
-        kv_layout=a.kv_layout,
-        spec_k=a.spec_k,
-    )
-    engine = Engine(cfg, params, ec)
-    engine.start()
-
-    rng = np.random.default_rng(0)
-    if a.repetitive:
-        # Repeated n-grams: the prompt-lookup proposer's best case
-        # (summarization/RAG-shaped workloads).
-        gram = rng.integers(10, cfg.vocab_size - 1, 8).tolist()
-        reps = -(-a.prompt_len // len(gram))
-        prompts = [
-            (gram * reps)[: a.prompt_len] for _ in range(a.requests)
-        ]
-    else:
-        prompts = [
-            rng.integers(10, cfg.vocab_size - 1, a.prompt_len).tolist()
-            for _ in range(a.requests)
-        ]
-
-    # Warm the executables (prefill bucket + decode) outside the clock.
-    engine.generate(prompts[0][:16], max_tokens=2, temperature=0.0)
-
-    done = []
-    lock = threading.Lock()
-
-    def run_one(p):
-        out = engine.generate(p, max_tokens=a.max_tokens, temperature=0.0)
-        with lock:
-            done.append(len(out))
-
-    t0 = time.perf_counter()
-    threads = [
-        threading.Thread(target=run_one, args=(p,)) for p in prompts
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    dt = time.perf_counter() - t0
-    engine.stop()
-
-    gen_tokens = sum(done)
-    total_tokens = gen_tokens + a.requests * a.prompt_len
-    spec = ""
-    if a.spec_k:
-        s = engine.stats
-        acc = (
-            s["spec_accepted"] / s["spec_proposed"]
-            if s["spec_proposed"] else 0.0
+        axes = dict(
+            (k, int(v))
+            for k, v in (kv.split("=") for kv in a.mesh.split(","))
         )
-        spec = (
-            f", \"spec_k\": {a.spec_k}, \"acceptance\": {acc:.3f}, "
-            f"\"verify_passes\": {s['verify_passes']}"
+        mesh = build_mesh(**axes)
+    result = measure(a, mesh=mesh)
+    if a.json_only:
+        print(json.dumps(result))
+        return 0
+    record = {
+        "metric": f"{a.config.replace('-', '_')}_engine_throughput",
+        "value": result["gen_tok_s"],
+        "unit": "gen_tokens/sec",
+        "total_tok_s": result["total_tok_s"],
+        "quantize": a.quantize,
+        "kv_layout": a.kv_layout,
+        "decode_impl": a.decode_impl,
+        "requests": a.requests,
+        "wall_s": result["wall_s"],
+        "ttft_p50_ms": result["ttft_ms"].get("p50"),
+    }
+    if result.get("spec"):
+        record.update(
+            spec_k=result["spec"]["spec_k"],
+            acceptance=result["spec"]["acceptance"],
+            verify_passes=result["spec"]["verify_passes"],
         )
-    print(
-        f"{{\"metric\": \"{a.config.replace('-', '_')}_engine_throughput\", "
-        f"\"value\": {gen_tokens / dt:.1f}, \"unit\": \"gen_tokens/sec\", "
-        f"\"total_tok_s\": {total_tokens / dt:.1f}, "
-        f"\"quantize\": \"{a.quantize}\", \"kv_layout\": \"{a.kv_layout}\", "
-        f"\"decode_impl\": \"{a.decode_impl}\", "
-        f"\"requests\": {a.requests}, \"wall_s\": {dt:.2f}{spec}}}"
-    )
+    if result.get("admission"):
+        record["admission"] = result["admission"]
+    print(json.dumps(record))
     return 0
 
 

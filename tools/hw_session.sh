@@ -6,6 +6,7 @@
 #
 #   bash tools/hw_session.sh            # full ladder (~20-30 min)
 #   bash tools/hw_session.sh quick      # parity probes only
+#   bash tools/hw_session.sh smoke      # CPU-scaled contract proof (no chip)
 #
 # Order matters:
 #   1. q4_onchip        — int4 kernel compiles + parity + vs-int8 bench
@@ -14,11 +15,15 @@
 #   3. flash_dkv_tune   — dkv grid sweep at the 8k/16h loser shape
 #   4. bench.py ladder  — the official capture, int4 first (auto), then
 #                         explicit variants for the record
+#   5. bench_train      — the SECOND baseline primary metric (7B LoRA
+#                         finetune step-time), same robustness contract
+#   6. engine benches   — aggregate tok/s incl. the 2-process lockstep
+#                         gang vs single comparison
 # Every step is independent: a failure logs and the session continues.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-OUT=tools/hw_out
+OUT=${HW_OUT:-tools/hw_out}
 mkdir -p "$OUT"
 ts() { date -u +%H:%M:%S; }
 FAILURES=0
@@ -40,6 +45,24 @@ run() {
   tail -5 "$OUT/$name.log"
 }
 
+if [ "${1:-}" = "smoke" ]; then
+  # CPU-scaled end-to-end proof of the capture contract: ONE session
+  # emits BOTH BASELINE primary metrics (serve tok/s/chip + 7B-shape
+  # LoRA finetune step-time) plus the lockstep gang comparison, each as
+  # a single parseable JSON line. CI and the tier-1 tests run this.
+  export JAX_PLATFORMS=cpu
+  run bench_auto   python bench.py --config tiny --batch 4 --cache-len 128 \
+                     --steps 8 --quantize int8 --no-fallback \
+                     --probe-timeout 60 --probe-budget 120
+  run bench_train  python tools/bench_train.py --smoke
+  run engine_gang  python tools/engine_bench.py --smoke --gang 2 \
+                     --transport tcp --long-admission 8200
+  echo
+  echo "captured JSON lines:"
+  grep -h '"metric"' "$OUT"/bench_*.log "$OUT"/engine_*.log 2>/dev/null || true
+  exit "$FAILURES"
+fi
+
 run q4_onchip          python tools/q4_onchip.py
 run fused_decode       python tools/fused_decode_onchip.py
 
@@ -49,6 +72,9 @@ if [ "${1:-}" != "quick" ]; then
   # the same invocation the driver makes — then the explicit variants
   # that make the comparison table in docs/performance.md.
   run bench_auto       python bench.py
+  # The SECOND baseline primary metric, right after the first: one live
+  # tunnel session captures serve tok/s/chip AND finetune step-time.
+  run bench_train      python tools/bench_train.py
   run bench_int8       python bench.py --quantize int8 --no-fallback
   run bench_int4       python bench.py --quantize int4 --no-fallback
   run bench_int4_fused python bench.py --quantize int4 --decode-impl fused --no-fallback
@@ -61,6 +87,10 @@ if [ "${1:-}" != "quick" ]; then
   run engine_stacked   python tools/engine_bench.py --quantize int4 \
                          --kv-layout dense --decode-impl fused \
                          --spec-k 4 --repetitive
+  # Lockstep gang vs single on the same shape, with the >=8k-token
+  # admission-broadcast leg (docs/performance.md lockstep section).
+  run engine_gang      python tools/engine_bench.py --gang 2 \
+                         --long-admission 8192
 fi
 
 echo

@@ -29,6 +29,7 @@ def main(axes_arg: str = "tensor=16") -> None:
     from substratus_tpu.ops.quant import QTensor
     from substratus_tpu.parallel.mesh import build_mesh
     from substratus_tpu.parallel.sharding import SERVE_RULES, sharding_tree
+    from substratus_tpu.utils.jaxcompat import ambient_mesh
 
     axes = {
         k: int(v) for k, v in
@@ -76,7 +77,7 @@ def main(axes_arg: str = "tensor=16") -> None:
     tokens = jax.ShapeDtypeStruct((batch,), jnp.int32)
     positions = jax.ShapeDtypeStruct((batch,), jnp.int32)
 
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         lowered = jax.jit(
             llama.decode_step, static_argnames=("cfg",),
             donate_argnames=("cache",),

@@ -3,22 +3,33 @@
 
 PY ?= python
 
-.PHONY: test test-int metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare graft image install-manifests
+.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
-# Exposition-format lint for the shared telemetry registry
-# (observability/metrics.py): unique families, HELP/TYPE present, label
-# escaping, histogram +Inf buckets.
-metrics-lint:
-	$(PY) hack/metrics_lint.py
+# Whole-repo static analysis (hack/sublint.py + substratus_tpu/analysis/):
+# shard (PartitionSpec axes vs the parallel/mesh.py registry), hostsync
+# (host-device syncs reachable from the engine decode loop / trainer
+# step), concurrency (cross-thread writes, thread lifecycle, blocking in
+# async), broad-except — plus the wrapped metrics/trace runtime lints.
+# Exits nonzero on any unsuppressed finding; suppressions require
+# reasons (docs/development.md#static-analysis-sublint). Also writes a
+# SARIF artifact for CI upload.
+lint:
+	$(PY) hack/sublint.py --sarif sublint.sarif
 
-# Span-export lint (observability/tracing.py JSONL contract): id widths,
-# parent referential integrity within a trace, non-negative durations.
-# `make trace-lint FILES=path.jsonl` lints a real export instead.
+# Aliases into the unified driver: one check family each. `make
+# trace-lint FILES=path.jsonl` still lints a real span export directly.
+metrics-lint:
+	$(PY) hack/sublint.py --checks metrics
+
 trace-lint:
+ifdef FILES
 	$(PY) hack/trace_lint.py $(FILES)
+else
+	$(PY) hack/sublint.py --checks trace
+endif
 
 # Controller integration tier only (fake apiserver; reference
 # `make test-integration`).

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Whole-repo static analysis driver (make lint).
+
+Runs the AST check families from substratus_tpu/analysis/ — shard,
+hostsync, concurrency, broad-except — over the whole package, plus the
+two runtime lints (metrics, trace) as wrapped subprocess checks. Exits
+nonzero on any unsuppressed finding. See
+docs/development.md#static-analysis-sublint for the check catalog and
+the suppression syntax (`# sublint: allow[family]: reason`).
+
+    python hack/sublint.py                      # everything, text output
+    python hack/sublint.py --checks shard,hostsync
+    python hack/sublint.py --format sarif       # SARIF to stdout
+    python hack/sublint.py --sarif out.sarif    # text + SARIF artifact
+    python hack/sublint.py --list               # check catalog
+
+The AST families never import the code under analysis (and this driver
+never executes the substratus_tpu package __init__), so `--checks`
+without metrics/trace runs anywhere python does — no jax, no TPU. The
+wrapped metrics/trace checks exercise the live telemetry registry and
+tracer in a subprocess and do need the runtime deps installed.
+"""
+import argparse
+import importlib
+import os
+import subprocess
+import sys
+import types
+
+sys.dont_write_bytecode = True
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# Load substratus_tpu.analysis without executing substratus_tpu/__init__
+# (which imports jax): register a namespace-only parent so the analysis
+# subpackage resolves through it. Harmless when the real package is
+# already imported.
+if "substratus_tpu" not in sys.modules:
+    _pkg = types.ModuleType("substratus_tpu")
+    _pkg.__path__ = [os.path.join(REPO_ROOT, "substratus_tpu")]
+    sys.modules["substratus_tpu"] = _pkg
+
+analysis = importlib.import_module("substratus_tpu.analysis")
+
+WRAPPED = {
+    "metrics": (
+        "hack/metrics_lint.py",
+        "exposition-format lint of the live telemetry registry",
+    ),
+    "trace": (
+        "hack/trace_lint.py",
+        "span-export JSONL contract lint of the live tracer",
+    ),
+}
+DEFAULT_CHECKS = list(analysis.AST_CHECKS) + list(WRAPPED)
+
+
+def run_wrapped(name: str) -> list:
+    """Run a runtime lint script in a subprocess; nonzero rc becomes
+    findings (one per stderr line, so the text/SARIF output carries the
+    real problems, not just 'it failed')."""
+    script, _ = WRAPPED[name]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, script)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    if proc.returncode == 0:
+        note = (proc.stdout or "").strip().splitlines()
+        print(note[-1] if note else f"{name}: ok")
+        return []
+    problems = [
+        ln.strip() for ln in (proc.stderr or "").splitlines() if ln.strip()
+    ] or [f"{script} exited {proc.returncode}"]
+    return [
+        analysis.Finding(
+            check=name, path=script, line=1, col=1, message=p
+        )
+        for p in problems
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--checks",
+        help="comma list of check families (default: all: %s)"
+        % ",".join(DEFAULT_CHECKS),
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="stdout format",
+    )
+    ap.add_argument("--sarif", help="also write a SARIF 2.1.0 file here")
+    ap.add_argument("--json", dest="json_out", help="also write JSON here")
+    ap.add_argument("--root", default=REPO_ROOT, help="repo root to lint")
+    ap.add_argument(
+        "--list", action="store_true", help="print the check catalog"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for cname, cls in analysis.AST_CHECKS.items():
+            print(f"{cname:14s} {cls.description}")
+        for wname, (script, desc) in WRAPPED.items():
+            print(f"{wname:14s} {desc} ({script})")
+        print(f"{'suppression':14s} malformed/unused allow[] comments (meta)")
+        return 0
+
+    selected = (
+        [c.strip() for c in args.checks.split(",") if c.strip()]
+        if args.checks
+        else DEFAULT_CHECKS
+    )
+    unknown = [
+        c for c in selected if c not in analysis.AST_CHECKS and c not in WRAPPED
+    ]
+    if unknown:
+        print(f"sublint: unknown checks {unknown}", file=sys.stderr)
+        return 2
+
+    files = analysis.load_files(
+        args.root, analysis.discover(args.root)
+    )
+    ast_checks = [
+        analysis.AST_CHECKS[c]() for c in selected if c in analysis.AST_CHECKS
+    ]
+    findings = analysis.run_checks(files, ast_checks)
+    for name in selected:
+        if name in WRAPPED:
+            findings.extend(run_wrapped(name))
+
+    active = [f for f in findings if not f.suppressed]
+    if args.format == "json":
+        print(analysis.render_json(findings))
+    elif args.format == "sarif":
+        print(analysis.render_sarif(findings, ast_checks))
+    else:
+        text = analysis.render_text(findings)
+        if text:
+            print(text)
+    if args.sarif:
+        with open(args.sarif, "w") as f:
+            f.write(analysis.render_sarif(findings, ast_checks))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(analysis.render_json(findings))
+
+    if active:
+        print(
+            f"sublint: {len(active)} unsuppressed finding(s) across "
+            f"{len({f.path for f in active})} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    n_supp = sum(1 for f in findings if f.suppressed)
+    print(
+        f"sublint: ok ({len(files)} files, "
+        f"{len(ast_checks)} AST checks, {n_supp} reasoned suppressions)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,65 @@
+"""Static-analysis subsystem behind `make lint` (driver: hack/sublint.py).
+
+Check families (the names are the suppression keys):
+
+  shard         PartitionSpec / LogicalRules / axis-name literals must
+                name axes from the canonical registry
+                (parallel/mesh.py MESH_AXES); no axis reuse in one spec
+  hostsync      host-device syncs reachable from the engine decode loop
+                and trainer step
+  concurrency   unlocked cross-thread attribute writes, threads without
+                daemon/join, blocking calls in async handlers
+  broad-except  except:/except Exception handlers that swallow
+
+Plus two meta families that are never suppressible: "suppression"
+(malformed/unused allow[] comments) and "parse" (unparseable files).
+The driver also wraps the runtime lints (hack/metrics_lint.py,
+hack/trace_lint.py) as registered checks named "metrics" and "trace".
+
+Everything here is import-light on purpose (ast + stdlib only) so the
+gate runs without jax or a TPU; hack/sublint.py loads this subpackage
+without executing the substratus_tpu package __init__.
+"""
+from substratus_tpu.analysis.broadexcept import BroadExceptCheck
+from substratus_tpu.analysis.concurrency import ConcurrencyCheck
+from substratus_tpu.analysis.core import (
+    Check,
+    Finding,
+    SourceFile,
+    apply_suppressions,
+    discover,
+    load_files,
+    parse_suppressions,
+    render_json,
+    render_sarif,
+    render_text,
+    run_checks,
+)
+from substratus_tpu.analysis.hostsync import HostSyncCheck
+from substratus_tpu.analysis.shardlint import ShardCheck
+
+AST_CHECKS = {
+    "shard": ShardCheck,
+    "hostsync": HostSyncCheck,
+    "concurrency": ConcurrencyCheck,
+    "broad-except": BroadExceptCheck,
+}
+
+__all__ = [
+    "AST_CHECKS",
+    "BroadExceptCheck",
+    "Check",
+    "ConcurrencyCheck",
+    "Finding",
+    "HostSyncCheck",
+    "ShardCheck",
+    "SourceFile",
+    "apply_suppressions",
+    "discover",
+    "load_files",
+    "parse_suppressions",
+    "render_json",
+    "render_sarif",
+    "render_text",
+    "run_checks",
+]

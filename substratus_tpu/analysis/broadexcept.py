@@ -1,0 +1,75 @@
+"""broad-except: `except:` / `except Exception:` handlers that swallow.
+
+A handler this wide hides real failures (the reconcile loop retrying a
+typo forever, a dead telemetry path nobody notices). Flagged unless the
+handler re-raises the caught exception (a bare ``raise`` anywhere in its
+body) — instrument-and-propagate wrappers stay legal. Deliberate
+swallows (telemetry must never fail work, probe paths) carry
+``# sublint: allow[broad-except]: reason`` on the ``except`` line, and
+should log with the current trace id (observability/tracing.py
+``current_trace_id``) so the swallow is at least visible in traces.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from substratus_tpu.analysis.core import Check, Finding, SourceFile
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(
+        isinstance(n, ast.Raise) and n.exc is None
+        for n in ast.walk(handler)
+    )
+
+
+class BroadExceptCheck(Check):
+    name = "broad-except"
+    description = (
+        "bare/Exception-wide handlers that swallow instead of "
+        "narrowing, re-raising, or logging with a documented reason"
+    )
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files.values():
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node) or _reraises(node):
+                    continue
+                what = (
+                    "bare 'except:'" if node.type is None
+                    else "broad 'except Exception'"
+                )
+                out.append(
+                    Finding(
+                        check="broad-except", path=sf.rel,
+                        line=node.lineno, col=node.col_offset + 1,
+                        message=(
+                            f"{what} swallows errors: narrow the type, "
+                            "re-raise, or suppress with a reason and log "
+                            "with the trace id "
+                            "(observability.tracing.current_trace_id)"
+                        ),
+                    )
+                )
+        return out

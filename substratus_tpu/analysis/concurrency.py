@@ -1,0 +1,294 @@
+"""concurrency-lint: thread and event-loop hazards.
+
+Three sub-checks:
+
+  * **shared-attr**: in the configured serving modules, a `self.x`
+    attribute written both by a thread-entry method (a method passed as
+    `Thread(target=self.m)` / `run_in_executor(None, self.m)`, plus its
+    intra-class callees) and by a method running on other threads, with
+    at least one of the writes outside a `with self.<lock>:` block. The
+    engine's threading contract — one scheduler thread owns all device
+    state — stays enforceable as the code grows.
+  * **thread-lifecycle**: `threading.Thread(...)` created neither
+    `daemon=True` nor `.join()`ed anywhere in the module — a thread
+    that can outlive shutdown silently.
+  * **async-blocking**: known blocking calls (`time.sleep`,
+    `subprocess.*`, `urllib.request.urlopen`, `os.system`, ...)
+    lexically inside an `async def` (nested sync `def`s are exempt:
+    that's the `run_in_executor` pattern).
+
+Writes in ``__init__`` are pre-thread construction and ignored.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
+
+DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
+    "serve/engine.py",
+    "serve/server.py",
+)
+
+_BLOCKING = {
+    "time.sleep": "time.sleep blocks the event loop",
+    "os.system": "os.system blocks the event loop",
+    "subprocess.run": "subprocess.run blocks the event loop",
+    "subprocess.call": "subprocess.call blocks the event loop",
+    "subprocess.check_call": "subprocess.check_call blocks the event loop",
+    "subprocess.check_output": "subprocess.check_output blocks the event loop",
+    "urllib.request.urlopen": "urlopen blocks the event loop",
+    "socket.create_connection": "socket connect blocks the event loop",
+}
+
+
+def _is_thread_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    return name == "threading.Thread" or name == "Thread"
+
+
+def _lock_guarded(with_stack: Sequence[ast.AST]) -> bool:
+    """True when any enclosing `with` context expression mentions a name
+    containing 'lock' or 'mutex' (e.g. `with self._lock:`)."""
+    for w in with_stack:
+        for item in getattr(w, "items", []):
+            expr = item.context_expr
+            for node in ast.walk(expr):
+                ident = None
+                if isinstance(node, ast.Attribute):
+                    ident = node.attr
+                elif isinstance(node, ast.Name):
+                    ident = node.id
+                if ident and (
+                    "lock" in ident.lower() or "mutex" in ident.lower()
+                ):
+                    return True
+    return False
+
+
+class _WriteCollector(ast.NodeVisitor):
+    """self.<attr> writes inside one method, with lock context."""
+
+    def __init__(self) -> None:
+        self.writes: List[Tuple[str, ast.AST, bool]] = []
+        self._with_stack: List[ast.AST] = []
+
+    def _record(self, target: ast.AST) -> None:
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            self.writes.append(
+                (target.attr, target, _lock_guarded(self._with_stack))
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._record(node.target)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        self._with_stack.append(node)
+        self.generic_visit(node)
+        self._with_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+
+def _self_target_methods(cls: ast.ClassDef) -> Set[str]:
+    """Method names handed to another thread: Thread(target=self.m) or
+    run_in_executor(<executor>, self.m)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        cands: List[ast.AST] = []
+        if _is_thread_call(node):
+            cands.extend(
+                kw.value for kw in node.keywords if kw.arg == "target"
+            )
+        elif call_name(node).endswith("run_in_executor") and len(node.args) >= 2:
+            cands.append(node.args[1])
+        for c in cands:
+            if (
+                isinstance(c, ast.Attribute)
+                and isinstance(c.value, ast.Name)
+                and c.value.id == "self"
+            ):
+                out.add(c.attr)
+    return out
+
+
+class ConcurrencyCheck(Check):
+    name = "concurrency"
+    description = (
+        "unlocked cross-thread attribute writes in the serving modules; "
+        "threads without daemon/join; blocking calls in async handlers"
+    )
+
+    def __init__(
+        self,
+        shared_attr_modules: Sequence[str] = DEFAULT_SHARED_ATTR_MODULES,
+    ):
+        self.shared_attr_modules = tuple(shared_attr_modules)
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files.values():
+            if sf.tree is None:
+                continue
+            out.extend(self._thread_lifecycle(sf))
+            out.extend(self._async_blocking(sf))
+            if any(sf.rel.endswith(m) for m in self.shared_attr_modules):
+                out.extend(self._shared_attrs(sf))
+        return out
+
+    # -- thread lifecycle --------------------------------------------------
+
+    def _thread_lifecycle(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        assigned: Dict[int, str] = {}  # Thread call lineno -> target source
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _is_thread_call(node.value):
+                    for t in node.targets:
+                        assigned[node.value.lineno] = ast.unparse(t)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+                continue
+            daemon = next(
+                (kw.value for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            if daemon is not None:
+                if isinstance(daemon, ast.Constant) and daemon.value is False:
+                    pass  # explicit non-daemon: fall through to join check
+                else:
+                    continue  # daemon=True or dynamic: accepted
+            target = assigned.get(node.lineno)
+            joined = target and f"{target}.join" in sf.text
+            if not joined:
+                out.append(
+                    Finding(
+                        check="concurrency", path=sf.rel,
+                        line=node.lineno, col=node.col_offset + 1,
+                        message=(
+                            "thread created without daemon=True and never "
+                            ".join()ed in this module — it can outlive "
+                            "shutdown; mark it daemon or join it"
+                        ),
+                    )
+                )
+        return out
+
+    # -- blocking calls inside async defs ---------------------------------
+
+    def _async_blocking(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+
+        def walk(node: ast.AST, in_async: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.AsyncFunctionDef):
+                    walk(child, True)
+                elif isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                    walk(child, False)  # executor-bound sync body
+                else:
+                    if in_async and isinstance(child, ast.Call):
+                        why = _BLOCKING.get(call_name(child))
+                        if why is not None:
+                            out.append(
+                                Finding(
+                                    check="concurrency", path=sf.rel,
+                                    line=child.lineno,
+                                    col=child.col_offset + 1,
+                                    message=(
+                                        f"{why}: run it in an executor "
+                                        "(await loop.run_in_executor) or "
+                                        "use the async equivalent"
+                                    ),
+                                )
+                            )
+                    walk(child, in_async)
+
+        walk(sf.tree, False)
+        return out
+
+    # -- cross-thread shared attribute writes ------------------------------
+
+    def _shared_attrs(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in sf.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                n.name: n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            entries = _self_target_methods(cls) & set(methods)
+            if not entries:
+                continue
+            # Closure of the thread-entry methods over self-calls.
+            owned: Set[str] = set()
+            frontier = list(entries)
+            while frontier:
+                cur = frontier.pop()
+                if cur in owned:
+                    continue
+                owned.add(cur)
+                for node in ast.walk(methods[cur]):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in methods
+                    ):
+                        frontier.append(node.func.attr)
+
+            def writes_of(names: Set[str]):
+                acc: Dict[str, List[Tuple[ast.AST, bool, str]]] = {}
+                for m in names:
+                    col = _WriteCollector()
+                    col.visit(methods[m])
+                    for attr, node, locked in col.writes:
+                        acc.setdefault(attr, []).append((node, locked, m))
+                return acc
+
+            others = set(methods) - owned - {"__init__"}
+            w_thread = writes_of(owned)
+            w_other = writes_of(others)
+            for attr in sorted(set(w_thread) & set(w_other)):
+                both = w_thread[attr] + w_other[attr]
+                unlocked = [(n, m) for n, locked, m in both if not locked]
+                if not unlocked:
+                    continue
+                node, method = unlocked[0]
+                sites = sorted(
+                    {f"{m}:{n.lineno}" for n, _l, m in both}
+                )
+                out.append(
+                    Finding(
+                        check="concurrency", path=sf.rel,
+                        line=node.lineno, col=node.col_offset + 1,
+                        message=(
+                            f"self.{attr} is written from the "
+                            f"{sorted(entries)} thread entry point(s) AND "
+                            f"from other-thread methods ({sites}) without "
+                            "a lock on every write — guard with a lock or "
+                            "confine writes to one thread"
+                        ),
+                    )
+                )
+        return out

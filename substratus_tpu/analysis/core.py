@@ -1,0 +1,324 @@
+"""Shared static-analysis framework behind `make lint` (hack/sublint.py).
+
+Findings, source loading, suppression parsing, and output rendering for
+the repo's AST lint families (shard, hostsync, concurrency,
+broad-except). Everything here is pure AST work: no jax, no devices, no
+imports of the code under analysis — the lint is the repo's first
+correctness gate that runs anywhere python does, TPU or not.
+
+Suppression syntax (per line, reason REQUIRED):
+
+    something_flagged()  # sublint: allow[hostsync]: one host read per step
+
+Multiple families on one line: ``allow[hostsync,shard]: reason``. A
+suppression without a reason, or one that suppresses nothing, is itself
+a finding (family "suppression") and cannot be suppressed — the
+suppression inventory stays honest.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*sublint:\s*allow\[([A-Za-z0-9_\-, ]+)\]\s*(?::\s*(\S.*))?"
+)
+
+
+@dataclass
+class Finding:
+    """One lint result. `check` is the family name the suppression syntax
+    keys on; `path` is repo-relative."""
+
+    check: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+@dataclass
+class SourceFile:
+    path: str  # absolute
+    rel: str  # repo-relative, forward slashes
+    text: str
+    tree: Optional[ast.Module]
+    error: Optional[str] = None
+    lines: List[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str, rel: str) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        tree, error = None, None
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            error = f"syntax error: {e.msg} (line {e.lineno})"
+        return cls(
+            path=path, rel=rel, text=text, tree=tree, error=error,
+            lines=text.splitlines(),
+        )
+
+
+class Check:
+    """Base class: a whole-repo check. Subclasses set `name` (the
+    suppression key) and implement run() over the loaded file set."""
+
+    name = ""
+    description = ""
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        raise NotImplementedError
+
+
+def discover(root: str, packages: Sequence[str] = ("substratus_tpu",)) -> List[str]:
+    """Repo-relative paths of every .py file under the given packages."""
+    rels: List[str] = []
+    for pkg in packages:
+        base = os.path.join(root, pkg)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    rels.append(os.path.relpath(full, root).replace(os.sep, "/"))
+    return sorted(rels)
+
+
+def load_files(root: str, rels: Iterable[str]) -> Dict[str, SourceFile]:
+    return {
+        rel: SourceFile.load(os.path.join(root, rel), rel) for rel in rels
+    }
+
+
+def _comment_tokens(sf: SourceFile) -> List[Tuple[int, int, str]]:
+    """(line, col, text) of real COMMENT tokens — docstrings that merely
+    *mention* the suppression syntax never count as suppressions."""
+    try:
+        return [
+            (tok.start[0], tok.start[1], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(sf.text).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Unparseable file: already a "parse" finding; best-effort lines.
+        return [
+            (i, 0, line)
+            for i, line in enumerate(sf.lines, 1)
+            if "#" in line
+        ]
+
+
+def parse_suppressions(
+    sf: SourceFile,
+) -> Tuple[Dict[int, Tuple[set, str]], List[Finding]]:
+    """Per-line suppressions: {line: (families, reason)}. Malformed
+    suppressions (missing reason) come back as findings."""
+    out: Dict[int, Tuple[set, str]] = {}
+    problems: List[Finding] = []
+    for i, col, comment in _comment_tokens(sf):
+        m = SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        families = {p.strip() for p in m.group(1).split(",") if p.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            problems.append(
+                Finding(
+                    check="suppression", path=sf.rel, line=i,
+                    col=col + m.start() + 1,
+                    message=(
+                        "suppression without a reason: write "
+                        "'# sublint: allow[family]: why this is deliberate'"
+                    ),
+                )
+            )
+            continue
+        out[i] = (families, reason)
+    return out, problems
+
+
+def apply_suppressions(
+    files: Dict[str, SourceFile],
+    findings: List[Finding],
+    ran_families: Optional[set] = None,
+) -> List[Finding]:
+    """Mark findings suppressed by a same-line allow[]; append findings
+    for malformed and unused suppressions. `ran_families` scopes the
+    unused-suppression detection: an allow[] for a family that did not
+    run this invocation (e.g. `--checks metrics`) is not "unused".
+    Returns the full list sorted by location."""
+    by_file: Dict[str, Dict[int, Tuple[set, str]]] = {}
+    out = list(findings)
+    for rel, sf in files.items():
+        supp, problems = parse_suppressions(sf)
+        by_file[rel] = supp
+        out.extend(problems)
+    used: Dict[Tuple[str, int], set] = {}
+    for f in out:
+        supp = by_file.get(f.path, {}).get(f.line)
+        if supp and f.check in supp[0] and f.check != "suppression":
+            f.suppressed = True
+            f.reason = supp[1]
+            used.setdefault((f.path, f.line), set()).add(f.check)
+    for rel, supp in by_file.items():
+        for line, (families, _reason) in supp.items():
+            unused = families - used.get((rel, line), set())
+            if ran_families is not None:
+                unused &= ran_families
+            if unused:
+                out.append(
+                    Finding(
+                        check="suppression", path=rel, line=line, col=1,
+                        message=(
+                            f"unused suppression for {sorted(unused)}: "
+                            "nothing was flagged on this line — remove it "
+                            "or fix the family name"
+                        ),
+                    )
+                )
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return out
+
+
+def run_checks(
+    files: Dict[str, SourceFile], checks: Sequence[Check]
+) -> List[Finding]:
+    """Run the given checks and fold in suppressions. Files that failed
+    to parse surface as findings instead of crashing the gate."""
+    findings: List[Finding] = []
+    for sf in files.values():
+        if sf.error is not None:
+            findings.append(
+                Finding(
+                    check="parse", path=sf.rel, line=1, col=1,
+                    message=sf.error,
+                )
+            )
+    for check in checks:
+        findings.extend(check.run(files))
+    return apply_suppressions(
+        files, findings, ran_families={c.name for c in checks}
+    )
+
+
+# --- small AST helpers shared by the check families ----------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Best-effort dotted name of a call target: `jax.device_get` ->
+    "jax.device_get", `x[0].item` -> ".item" (unresolvable base becomes
+    a leading dot so suffix checks still work)."""
+    parts: List[str] = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    elif parts:
+        parts.append("")
+    return ".".join(reversed(parts))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --- renderers ------------------------------------------------------------
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    lines = []
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    for f in active:
+        lines.append(f"{f.location()}: [{f.check}] {f.message}")
+    if suppressed:
+        lines.append(
+            f"({len(suppressed)} finding(s) suppressed in-source with reasons)"
+        )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        [
+            {
+                "check": f.check,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "suppressed": f.suppressed,
+                "reason": f.reason,
+            }
+            for f in findings
+        ],
+        indent=2,
+    )
+
+
+def render_sarif(
+    findings: Sequence[Finding], checks: Sequence[Check] = ()
+) -> str:
+    """SARIF 2.1.0 — one run, one rule per check family; suppressed
+    findings carry their in-source justification."""
+    rule_ids = sorted(
+        {f.check for f in findings} | {c.name for c in checks if c.name}
+    )
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.check,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": f.line, "startColumn": max(f.col, 1)
+                        },
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {"kind": "inSource", "justification": f.reason}
+            ]
+        results.append(result)
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "sublint",
+                        "informationUri": (
+                            "docs/development.md#static-analysis-sublint"
+                        ),
+                        "rules": [{"id": rid} for rid in rule_ids],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

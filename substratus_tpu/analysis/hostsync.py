@@ -1,0 +1,176 @@
+"""hostsync-lint: host-device synchronization points in hot loops.
+
+A single stray `.item()` / `np.asarray` / `jax.device_get` in the
+engine's decode loop or the trainer's step serializes the host against
+the device and caps achieved MFU (the Podracer / Gemma-on-TPU lesson:
+host syncs dominate once the per-step compute is tuned). This check
+builds the intra-module call graph from each configured hot-loop root
+and flags every statically-recognizable sync reachable from it:
+
+  * ``x.item()``
+  * ``jax.device_get(...)``
+  * ``jax.block_until_ready(...)`` / ``x.block_until_ready()``
+  * ``np.asarray(...)`` / ``numpy.asarray(...)``
+  * ``int(f(...))`` / ``float(f(...))`` — a call or attribute result
+    coerced to a python scalar (``int(name)`` / ``int(arr[i])`` are
+    skipped: in this codebase those read host-side numpy mirrors, and
+    flagging them would bury the real syncs in noise)
+
+The deliberate ones — the one host read per decode step that emits
+tokens, telemetry flush points — carry
+``# sublint: allow[hostsync]: reason`` so every accepted sync is
+documented at its site. Reachability is intra-module (self.method and
+module-function edges); jitted bodies built outside the loop are
+correctly out of scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
+
+DEFAULT_ROOTS: Tuple[Tuple[str, str], ...] = (
+    ("serve/engine.py", "Engine._loop"),
+    ("train/trainer.py", "Trainer.train_step"),
+)
+
+_SYNC_DOTTED = {
+    "jax.device_get": "jax.device_get() copies device buffers to host",
+    "np.asarray": "np.asarray() on a device array blocks on a transfer",
+    "numpy.asarray": "numpy.asarray() on a device array blocks on a transfer",
+}
+
+
+def _index_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Qualified name -> def node, for module functions and class
+    methods (one level: `f` and `Class.method`)."""
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+
+def _callees(
+    qual: str, fn: ast.AST, index: Dict[str, ast.AST]
+) -> List[str]:
+    """Intra-module call edges out of `fn` (including nested defs):
+    `self.m(...)` -> same-class method, `g(...)` -> module function."""
+    cls = qual.split(".")[0] if "." in qual else None
+    out: List[str] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (
+            cls is not None
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("self", "cls")
+        ):
+            cand = f"{cls}.{f.attr}"
+            if cand in index:
+                out.append(cand)
+        elif isinstance(f, ast.Name) and f.id in index:
+            out.append(f.id)
+    return out
+
+
+def reachable_from(
+    tree: ast.Module, root: str
+) -> Optional[Dict[str, ast.AST]]:
+    """BFS closure of the intra-module call graph from `root`
+    ("Class.method" or "function"). None when the root doesn't exist."""
+    index = _index_functions(tree)
+    if root not in index:
+        return None
+    seen = {root}
+    frontier = [root]
+    while frontier:
+        cur = frontier.pop()
+        for nxt in _callees(cur, index[cur], index):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return {q: index[q] for q in seen}
+
+
+def _classify_sync(node: ast.Call) -> Optional[str]:
+    """A human message when this call is a recognizable host sync."""
+    name = call_name(node)
+    last = name.rsplit(".", 1)[-1]
+    if name in _SYNC_DOTTED:
+        return _SYNC_DOTTED[name]
+    if last == "item" and "." in name and not node.args:
+        return ".item() forces a device->host scalar read"
+    if last == "block_until_ready":
+        return "block_until_ready() stalls the host on device completion"
+    if (
+        isinstance(node.func, ast.Name)
+        and node.func.id in ("int", "float")
+        and len(node.args) == 1
+        and isinstance(node.args[0], (ast.Call, ast.Attribute))
+    ):
+        return (
+            f"{node.func.id}() on a computed value forces a device->host "
+            "scalar read when the operand is a device array"
+        )
+    return None
+
+
+class HostSyncCheck(Check):
+    name = "hostsync"
+    description = (
+        "host-device sync constructs (.item, device_get, np.asarray, "
+        "block_until_ready, int/float coercion) reachable from the "
+        "engine decode loop and the trainer step"
+    )
+
+    def __init__(self, roots: Sequence[Tuple[str, str]] = DEFAULT_ROOTS):
+        self.roots = tuple(roots)
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for suffix, root in self.roots:
+            sf = next(
+                (s for r, s in sorted(files.items()) if r.endswith(suffix)),
+                None,
+            )
+            if sf is None or sf.tree is None:
+                continue  # module not in the lint scope (fixture runs)
+            reach = reachable_from(sf.tree, root)
+            if reach is None:
+                out.append(
+                    Finding(
+                        check="hostsync", path=sf.rel, line=1, col=1,
+                        message=(
+                            f"hot-loop root {root!r} not found — update "
+                            "analysis/hostsync.py DEFAULT_ROOTS after "
+                            "renaming the loop"
+                        ),
+                    )
+                )
+                continue
+            for qual, fn in sorted(reach.items()):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    why = _classify_sync(node)
+                    if why is None:
+                        continue
+                    out.append(
+                        Finding(
+                            check="hostsync", path=sf.rel,
+                            line=node.lineno, col=node.col_offset + 1,
+                            message=(
+                                f"{why} (in {qual}, reachable from the "
+                                f"{root} hot loop)"
+                            ),
+                        )
+                    )
+        return out

@@ -1,0 +1,264 @@
+"""shardlint: static validation of sharding-spec construction sites.
+
+The PR 3 axis-overlap bugs (ops/quant4.py, ops/kernel_partition.py) were
+mesh-axis bookkeeping errors that only surfaced at runtime on a sharded
+mesh. This check catches the statically-decidable slice of that bug
+class at lint time, against the canonical mesh-axis registry
+(parallel/mesh.py MESH_AXES — read from its AST, never imported):
+
+  * every literal axis name in a PartitionSpec/P(...) construction must
+    be a registered mesh axis (an axis absent from the registry is
+    absent from every mesh build_mesh can produce);
+  * one mesh axis may appear only once per spec — reuse across the
+    dimensions of a single P(...) is flagged, with tuple entries
+    flattened (P("data", ("data", "tensor")) collides on "data");
+  * LogicalRules tables and .replace(...) updates: the mesh-axis side
+    of every rule must be registered;
+  * axis_name= / axis_names= keyword literals (psum, shard_map, ring /
+    ulysses attention) and function defaults must be registered;
+  * mesh.shape["..."] subscripts must name a registered axis.
+
+Dynamic specs (P(*parts), P(m_axis, n_axis)) are skipped — the runtime
+overlap checks in ops/ own those.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from substratus_tpu.analysis.core import (
+    Check,
+    Finding,
+    SourceFile,
+    call_name,
+    const_str,
+)
+
+MESH_MODULE = "parallel/mesh.py"
+
+
+def load_registry(files: Dict[str, SourceFile]) -> Optional[Tuple[str, ...]]:
+    """Parse MESH_AXES out of parallel/mesh.py's AST — the registry is
+    read from source so the lint never imports jax."""
+    for rel, sf in files.items():
+        if not rel.endswith(MESH_MODULE) or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "MESH_AXES":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        axes = [const_str(e) for e in node.value.elts]
+                        if all(a is not None for a in axes):
+                            return tuple(axes)
+    return None
+
+
+def _flatten_spec_entry(node: ast.AST) -> Tuple[List[str], bool]:
+    """(literal axis names, fully_literal) for one P(...) entry."""
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return [], True
+        if isinstance(node.value, str):
+            return [node.value], True
+        return [], False
+    if isinstance(node, (ast.Tuple, ast.List)):
+        names: List[str] = []
+        literal = True
+        for e in node.elts:
+            sub, lit = _flatten_spec_entry(e)
+            names.extend(sub)
+            literal = literal and lit
+        return names, literal
+    return [], False
+
+
+class ShardCheck(Check):
+    name = "shard"
+    description = (
+        "PartitionSpec / LogicalRules / axis-name literals validate "
+        "against the canonical mesh-axis registry (parallel/mesh.py); "
+        "no axis reuse within one spec"
+    )
+
+    def __init__(self, registry: Optional[Sequence[str]] = None):
+        self.registry = tuple(registry) if registry is not None else None
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        registry = self.registry or load_registry(files)
+        if registry is None:
+            return [
+                Finding(
+                    check="shard", path=MESH_MODULE, line=1, col=1,
+                    message=(
+                        "mesh-axis registry not found: expected a literal "
+                        "MESH_AXES = (...) in parallel/mesh.py"
+                    ),
+                )
+            ]
+        out: List[Finding] = []
+        for sf in files.values():
+            if sf.tree is not None:
+                out.extend(self._run_module(sf, frozenset(registry), registry))
+        return out
+
+    def _run_module(
+        self, sf: SourceFile, known: frozenset, registry: Tuple[str, ...]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        pspec_names = {"PartitionSpec"}
+        rules_names = set()
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        pspec_names.add(alias.asname or alias.name)
+                    if node.module.endswith("parallel.sharding") and (
+                        alias.name.isupper()
+                    ):
+                        rules_names.add(alias.asname or alias.name)
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                fn = call_name(node.value)
+                derived = fn == "LogicalRules" or (
+                    fn.endswith(".replace")
+                    and isinstance(node.value.func, ast.Attribute)
+                    and isinstance(node.value.func.value, ast.Name)
+                    and node.value.func.value.id in rules_names
+                )
+                if derived:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            rules_names.add(tgt.id)
+
+        def bad_axis(name: str, where: ast.AST, what: str) -> None:
+            out.append(
+                Finding(
+                    check="shard", path=sf.rel, line=where.lineno,
+                    col=where.col_offset + 1,
+                    message=(
+                        f"unknown mesh axis {name!r} in {what}: not in the "
+                        f"registry {registry} (parallel/mesh.py MESH_AXES) — "
+                        "no declared mesh carries it"
+                    ),
+                )
+            )
+
+        def check_rule_value(value: ast.AST, where: ast.AST, what: str) -> None:
+            names, _ = _flatten_spec_entry(value)
+            for n in names:
+                if n not in known:
+                    bad_axis(n, where, what)
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                self._check_call(
+                    node, sf, known, pspec_names, rules_names,
+                    bad_axis, check_rule_value, out,
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # def f(..., axis_name: str = "sequence")
+                args = node.args
+                all_args = args.args + args.kwonlyargs
+                defaults = (
+                    [None] * (len(args.args) - len(args.defaults))
+                    + list(args.defaults)
+                    + list(args.kw_defaults)
+                )
+                for a, d in zip(all_args, defaults):
+                    if d is None or a.arg not in ("axis_name", "axis_names"):
+                        continue
+                    names, _ = _flatten_spec_entry(d)
+                    for n in names:
+                        if n not in known:
+                            bad_axis(n, d, f"default of {a.arg!r}")
+            elif isinstance(node, ast.Subscript):
+                # mesh.shape["tensor"]
+                if (
+                    isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "shape"
+                ):
+                    key = node.slice
+                    name = const_str(key)
+                    if name is not None and name not in known:
+                        bad_axis(name, node, "a mesh.shape[...] lookup")
+        return out
+
+    def _check_call(
+        self, node, sf, known, pspec_names, rules_names,
+        bad_axis, check_rule_value, out,
+    ) -> None:
+        fn = call_name(node)
+        base = fn.rsplit(".", 1)[-1]
+
+        # PartitionSpec construction: unknown axes + intra-spec reuse.
+        if base in pspec_names or fn.endswith(".PartitionSpec"):
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                return  # dynamic P(*parts)
+            seen: Dict[str, int] = {}
+            for arg in node.args:
+                names, _ = _flatten_spec_entry(arg)
+                for n in names:
+                    if n not in known:
+                        bad_axis(n, node, "a PartitionSpec")
+                    seen[n] = seen.get(n, 0) + 1
+            dupes = sorted(n for n, c in seen.items() if c > 1)
+            if dupes:
+                out.append(
+                    Finding(
+                        check="shard", path=sf.rel, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"mesh axis reuse within one PartitionSpec: "
+                            f"{dupes} appear in more than one dimension "
+                            "(one mesh axis may shard at most one dim; "
+                            "tuple entries flatten)"
+                        ),
+                    )
+                )
+            return
+
+        # LogicalRules((logical, mesh_axes), ...): validate the mesh side.
+        if base == "LogicalRules" and node.args:
+            table = node.args[0]
+            if isinstance(table, (ast.Tuple, ast.List)):
+                for pair in table.elts:
+                    if (
+                        isinstance(pair, (ast.Tuple, ast.List))
+                        and len(pair.elts) == 2
+                    ):
+                        check_rule_value(
+                            pair.elts[1], pair, "a LogicalRules mapping"
+                        )
+            return
+
+        # RULES.replace(logical="mesh_axis", ...)
+        if (
+            base == "replace"
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in rules_names
+        ):
+            for kw in node.keywords:
+                if kw.arg is not None and kw.value is not None:
+                    check_rule_value(
+                        kw.value, kw.value, f"LogicalRules.replace({kw.arg}=)"
+                    )
+            return
+
+        # axis_name= / axis_names= keyword literals (psum, shard_map, ...).
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                src = kw.value
+                elts = (
+                    src.elts
+                    if isinstance(src, (ast.Set, ast.Tuple, ast.List))
+                    else [src]
+                )
+                for e in elts:
+                    n = const_str(e)
+                    if n is not None and n not in known:
+                        bad_axis(n, e, f"{kw.arg}=")

@@ -117,7 +117,7 @@ def port_forward(
                 namespace, pod, local_port, remote_port, stop=stop
             )
             return  # clean stop
-        except Exception as e:
+        except Exception as e:  # sublint: allow[broad-except]: any forward error is retried with backoff; surfaced via emit below
             if stop is not None and stop.is_set():
                 return
             if time.monotonic() - started > 10.0:

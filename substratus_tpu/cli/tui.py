@@ -73,7 +73,7 @@ class Context:
                 out = fn()
                 if out is not None:
                     self.post(out)
-            except BaseException as e:  # surfaced to update(), not lost
+            except BaseException as e:  # sublint: allow[broad-except]: surfaced to update() as ErrMsg, not lost
                 self.post(ErrMsg(e))
 
         threading.Thread(target=run, daemon=True).start()

@@ -20,6 +20,7 @@ import time
 from typing import Optional
 
 from substratus_tpu.kube.client import Conflict, KubeClient, NotFound
+from substratus_tpu.observability.tracing import current_trace_id
 
 log = logging.getLogger("substratus.leader")
 
@@ -116,11 +117,11 @@ class LeaderElector:
                 time.sleep(self.lease_seconds / 3)
                 try:
                     ok = self._try_acquire()
-                except Exception:
-                    # Transient apiserver/network errors are failed
-                    # renewals, not thread-killers: keep retrying until the
-                    # lease deadline passes.
-                    log.exception("lease renewal error")
+                except Exception:  # sublint: allow[broad-except]: any renewal error is a failed renewal, never a thread-killer; retried until the lease deadline
+                    log.exception(
+                        "lease renewal error (trace_id=%s)",
+                        current_trace_id(),
+                    )
                     ok = False
                 if ok:
                     last_renewed = time.monotonic()

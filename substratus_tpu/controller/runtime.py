@@ -214,7 +214,7 @@ class Manager:
             except NotFound:
                 span.set_attribute("outcome", "gone")
                 return
-            except Exception as e:
+            except Exception as e:  # sublint: allow[broad-except]: one bad reconcile must not kill the manager; counted, evented, and logged
                 log.exception("reconcile %s %s/%s failed", kind, ns, name)
                 METRICS.inc(
                     "substratus_reconcile_errors_total", {"kind": kind}

@@ -12,6 +12,7 @@ kubeconfig via kube/config.py (tokens, client certs, exec plugins).
 """
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
@@ -24,6 +25,9 @@ import urllib.request
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from substratus_tpu.api.types import GROUP, VERSION
+from substratus_tpu.observability.tracing import (
+    current_trace_id as _current_trace_id,
+)
 from substratus_tpu.kube.client import (
     Conflict,
     KubeClient,
@@ -218,9 +222,23 @@ class RealKube(KubeClient):
                         obj.setdefault("kind", kind)
                         rv = obj.get("metadata", {}).get("resourceVersion", rv)
                         for fn in self._listeners:
-                            fn(event.get("type", "MODIFIED"), obj)
-            except Exception:
-                # watch dropped (timeout, apiserver restart): resume.
+                            try:
+                                fn(event.get("type", "MODIFIED"), obj)
+                            except Exception:  # sublint: allow[broad-except]: a buggy listener must not kill the shared watch; logged with trace id
+                                logging.getLogger(__name__).exception(
+                                    "watch listener failed for %s "
+                                    "(trace_id=%s)", kind,
+                                    _current_trace_id(),
+                                )
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                # Watch dropped (timeout, apiserver restart, truncated
+                # JSON): resume from the last resourceVersion. OSError
+                # covers socket/ssl/urllib.error; ValueError covers
+                # json decode. Anything else is a real bug and raises.
+                logging.getLogger(__name__).debug(
+                    "watch %s dropped (%s: %s); resuming", kind,
+                    type(e).__name__, e,
+                )
                 self._stop.wait(2.0)
 
     def stop(self) -> None:
@@ -431,7 +449,7 @@ class RealKube(KubeClient):
                 urllib.parse.urlencode([("ports", str(remote_port))]),
                 ("portforward.k8s.io",),
             )
-        except Exception as e:  # noqa: BLE001 — surfaced via the accept loop
+        except Exception as e:  # sublint: allow[broad-except]: dial failure of any kind is surfaced via pf_state to the accept loop and logged
             pf_state["failures"] += 1
             pf_state["last_error"] = e
             log.warning("port-forward dial %s/%s:%s failed: %s",
@@ -447,9 +465,8 @@ class RealKube(KubeClient):
                     conn.sendall(chunk)
             except OSError:
                 pass  # local browser/tool hung up; routine
-            except Exception as e:  # noqa: BLE001
-                # WSError from the error channel: pod-side failure worth
-                # telling the user about (kubectl printed these too).
+            except Exception as e:  # sublint: allow[broad-except]: WSError from the error channel — pod-side failure worth logging, never fatal
+                # (kubectl printed these too)
                 log.warning("port-forward stream %s/%s:%s: %s",
                             namespace, pod, remote_port, e)
             finally:

@@ -34,6 +34,7 @@ from substratus_tpu.observability.tracing import (  # noqa: F401
     Span,
     SpanContext,
     Tracer,
+    current_trace_id,
     tracer,
 )
 from substratus_tpu.observability.propagation import (  # noqa: F401
@@ -63,6 +64,7 @@ __all__ = [
     "SpanContext",
     "Tracer",
     "context_from_env",
+    "current_trace_id",
     "current_traceparent",
     "deterministic_traceparent",
     "escape_label_value",

@@ -177,7 +177,7 @@ class EventRecorder:
                     }
                 )
                 client.update(live)
-        except Exception:  # noqa: BLE001 — telemetry must never fail work
+        except Exception:  # sublint: allow[broad-except]: telemetry must never fail the work it observes
             log.debug("event write-through failed", exc_info=True)
 
 

@@ -201,3 +201,12 @@ class Tracer:
 
 
 tracer = Tracer()
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the calling context's active span, or None. The log
+    correlation hook: broad exception handlers that swallow deliberately
+    include this in their log line so the swallow is findable from
+    /debug/tracez (see the broad-except lint, docs/development.md)."""
+    ctx = _current.get()
+    return ctx.trace_id if ctx is not None else None

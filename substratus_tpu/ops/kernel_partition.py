@@ -20,6 +20,10 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Sequence, Tuple
 
+# Canonical mesh-axis flattening (parallel/mesh.py) — shared with
+# ops/quant4.py so tuple-spec overlap semantics can never drift again.
+from substratus_tpu.parallel.mesh import axis_names
+
 Dims = Tuple[Optional[int], Optional[int]]  # (batch dim idx, head dim idx)
 
 
@@ -46,9 +50,8 @@ def bh_partitioned(
     single = len(out_dims) == 1
 
     def _axis_size(mesh, axis) -> int:
-        names = axis if isinstance(axis, tuple) else (axis,)
         size = 1
-        for n in names:
+        for n in axis_names(axis):
             size *= int(mesh.shape[n])
         return size
 
@@ -63,13 +66,14 @@ def bh_partitioned(
         bdim, hdim = arg_dims[ref]
         b, h = at(bdim), at(hdim)
 
-        def _names(axis) -> set:
-            return set(axis) if isinstance(axis, tuple) else {axis}
-
         # One mesh axis cannot appear twice in a sharding. The overlap
         # check must flatten tuple specs: b="data" vs h=("data", "tensor")
         # collides on "data" just as surely as b == h exactly.
-        if b is not None and h is not None and _names(b) & _names(h):
+        if (
+            b is not None
+            and h is not None
+            and set(axis_names(b)) & set(axis_names(h))
+        ):
             b = None
 
         # An axis is only usable if it divides EVERY dimension it would

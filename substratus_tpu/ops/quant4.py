@@ -50,6 +50,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Canonical mesh-axis helpers (parallel/mesh.py): the axis-name
+# flattening every overlap check shares — this module used to carry its
+# own copy, and the PR 3 tuple-spec overlap bug came from that drift.
+from substratus_tpu.parallel.mesh import axis_names as _axis_names
+
 BLOCK = 128  # pack-fold / scale-group size along the packed dim
 
 
@@ -320,10 +325,6 @@ def _q4_axes(mesh, arg_shapes, block: int):
     return m_axis, c_axis, n_axis
 
 
-def _axis_names(axis) -> tuple:
-    return axis if isinstance(axis, tuple) else (axis,)
-
-
 def np_prod(it) -> int:
     p = 1
     for v in it:
@@ -418,7 +419,7 @@ def _use_pallas() -> bool:
         return _FORCE_IMPL == "pallas"
     try:
         return jax.default_backend() == "tpu"
-    except Exception:  # noqa: BLE001 — backend init failure means no TPU
+    except Exception:  # sublint: allow[broad-except]: backend init failure of any kind means no TPU; fall back to XLA
         return False
 
 

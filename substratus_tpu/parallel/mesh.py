@@ -29,7 +29,32 @@ from jax.sharding import Mesh
 # Order matters: outer-to-inner. data/stage outermost so multi-slice DCN
 # traffic is confined to data-parallel gradient all-reduce and pipeline
 # stage-boundary transfers (both DCN-friendly: large, infrequent).
+#
+# This tuple is the CANONICAL mesh-axis registry: every mesh this repo
+# builds carries exactly these names, every PartitionSpec literal must
+# draw from them, and the shard lint (substratus_tpu/analysis/shardlint
+# .py, `make lint`) validates the whole package against it by parsing
+# this assignment out of the AST — keep it a literal.
 MESH_AXES = ("data", "stage", "fsdp", "sequence", "tensor", "expert")
+
+# Membership form of the registry, for runtime validation.
+KNOWN_AXES = frozenset(MESH_AXES)
+
+
+def axis_names(axis) -> tuple:
+    """Flatten one PartitionSpec entry — a mesh-axis name, a tuple of
+    names, or None — to a tuple of axis names.
+
+    The single shared helper behind every axis-overlap check:
+    ops/quant4.py and ops/kernel_partition.py used to carry private
+    copies of both this flattening and their axis bookkeeping, and the
+    PR 3 tuple-spec overlap bugs came exactly from that drift. One
+    definition, one semantics."""
+    if axis is None:
+        return ()
+    if isinstance(axis, (tuple, list)):
+        return tuple(axis)
+    return (axis,)
 
 
 def build_mesh(

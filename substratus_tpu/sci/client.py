@@ -14,13 +14,16 @@ call an SCI client. Implementations:
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from typing import Optional
 
 from substratus_tpu.observability.metrics import METRICS
-from substratus_tpu.observability.tracing import tracer
+from substratus_tpu.observability.tracing import current_trace_id, tracer
+
+log = logging.getLogger(__name__)
 
 METRICS.histogram(
     "substratus_sci_request_seconds",
@@ -49,8 +52,14 @@ def traced(method: str):
                 ):
                     return fn(self, *args, **kwargs)
             except Exception:
+                # Counted and logged with the trace id, then propagated:
+                # callers own retry policy, operators own correlation.
                 METRICS.inc(
                     "substratus_sci_errors_total", {"method": method}
+                )
+                log.warning(
+                    "sci.%s failed (trace_id=%s)", method,
+                    current_trace_id(), exc_info=True,
                 )
                 raise
             finally:

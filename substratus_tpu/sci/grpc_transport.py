@@ -102,7 +102,7 @@ def _server_span(method: str, context):
         try:
             meta = {k: v for k, v in (context.invocation_metadata() or ())}
             parent = parse_traceparent(meta.get("traceparent"))
-        except Exception:  # noqa: BLE001 — tracing never fails an RPC
+        except Exception:  # sublint: allow[broad-except]: tracing never fails an RPC; a bad traceparent just starts a fresh root
             parent = None
     return tracer.span(f"sci.server.{method}", parent=parent)
 

@@ -19,6 +19,7 @@ scheduler thread owns all device state — no locks around jax values.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -33,7 +34,11 @@ import numpy as np
 from substratus_tpu.models import llama
 from substratus_tpu.models.llama import LlamaConfig, Params
 from substratus_tpu.observability.metrics import METRICS, RATIO_BUCKETS
-from substratus_tpu.observability.tracing import SpanContext, tracer
+from substratus_tpu.observability.tracing import (
+    SpanContext,
+    current_trace_id,
+    tracer,
+)
 from substratus_tpu.ops.sampling import sample
 
 # Serving latency/utilization histograms (docs/observability.md). Declared
@@ -774,7 +779,7 @@ class Engine:
                 break
             admitted += 1
         self.stats["max_active"] = max(
-            self.stats["max_active"], int(self.active.sum())
+            self.stats["max_active"], int(self.active.sum())  # sublint: allow[hostsync]: self.active is a host numpy mirror, no device read
         )
         return admitted
 
@@ -894,7 +899,7 @@ class Engine:
             np.array([req.temperature], np.float32),
             np.array([req.top_p], np.float32),
         )
-        self.key = np.asarray(key_out)
+        self.key = np.asarray(key_out)  # sublint: allow[hostsync]: first-token sample + key readback, once per admission (the "sample" phase)
         first_id = int(first[0])
         METRICS.observe(
             "substratus_serve_phase_seconds",
@@ -1009,7 +1014,7 @@ class Engine:
             self.top_ps,
             self.key,
         )
-        self.key = np.asarray(key_out)
+        self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side so lockstep processes feed identical replicated inputs
         # Clamp at the last cache row: active slots are released at the
         # window before reaching it (_emit's hit_window), so the clamp only
         # catches INACTIVE slots, whose positions otherwise drift past the
@@ -1019,7 +1024,7 @@ class Engine:
         last = self.ec.max_seq_len - 1
         self.positions = np.minimum(self.positions + 1, last)
         self.host_positions = np.minimum(self.host_positions + 1, last)
-        host_tokens = np.asarray(next_tokens)
+        host_tokens = np.asarray(next_tokens)  # sublint: allow[hostsync]: THE one host read per decode step — emitting tokens requires it
         self.tokens = host_tokens.copy()
         for slot in np.flatnonzero(self.active):
             self._emit(int(slot), int(host_tokens[slot]))
@@ -1031,7 +1036,7 @@ class Engine:
         first). Returns k tokens, or None when nothing matches — pure
         host work, no model involved; the scan is vectorized numpy so a
         max-context slot costs microseconds, not interpreter loops."""
-        a = np.asarray(ctx, np.int32)
+        a = np.asarray(ctx, np.int32)  # sublint: allow[hostsync]: ctx is a python token list; pure host work by design
         L = a.size
         for n in range(min(max_n, L - 1), 0, -1):
             tgt = a[L - n:]
@@ -1109,7 +1114,7 @@ class Engine:
                 self.draft_params, self.draft_cache, bt,
                 self.tokens, self.positions,
             )
-            props = np.asarray(proposals)
+            props = np.asarray(proposals)  # sublint: allow[hostsync]: draft proposals must reach host for the accept/reject walk
         else:
             props = lookup_props
         block = np.concatenate([self.tokens[:, None], props], axis=1)
@@ -1117,11 +1122,11 @@ class Engine:
             self.params, self.cache, bt, block,
             self.positions, self.temps, self.top_ps, self.key,
         )
-        self.key = np.asarray(key_out)
+        self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side (lockstep replication contract)
         self.stats["verify_passes"] += 1
 
-        chs = np.asarray(choices)
-        smp = np.asarray(sampled)
+        chs = np.asarray(choices)  # sublint: allow[hostsync]: THE per-spec-round host read — acceptance walk + emit need the verify output
+        smp = np.asarray(sampled)  # sublint: allow[hostsync]: same read as chs; one transfer per speculative round
         next_tokens = self.tokens.copy()
         for slot in np.flatnonzero(self.active):
             slot = int(slot)
@@ -1238,7 +1243,7 @@ class Engine:
                     continue
                 METRICS.observe(
                     "substratus_serve_batch_occupancy_ratio",
-                    float(self.active.sum()) / self.ec.max_batch,
+                    float(self.active.sum()) / self.ec.max_batch,  # sublint: allow[hostsync]: telemetry on the host numpy active mask, no device read
                 )
                 if self.paged:
                     METRICS.observe(
@@ -1280,8 +1285,11 @@ class Engine:
 
                 try:
                     self.sync.broadcast(encode_events([], [], True))
-                except Exception:
-                    pass  # the collective itself may be what broke
+                except Exception:  # sublint: allow[broad-except]: the collective itself may be what broke; the original error is re-raised below
+                    logging.getLogger(__name__).warning(
+                        "stop broadcast failed after engine error "
+                        "(trace_id=%s)", current_trace_id(), exc_info=True,
+                    )
 
             def kill(req: Request) -> None:
                 # "error", not the "stop" default: consumers must be able

@@ -93,9 +93,9 @@ class ServerState:
         if tmpl is not None:
             try:
                 rendered = tmpl(messages)
-            except Exception:  # noqa: BLE001 — a broken template must
-                # not take down the endpoint, but silence here would
-                # serve off-format prompts with no trace
+            except Exception:  # sublint: allow[broad-except]: a broken template must not take down the endpoint
+                # ...but silence here would serve off-format prompts
+                # with no trace, hence the loud log below.
                 logging.getLogger(__name__).exception(
                     "chat template failed; using the generic transcript"
                 )
@@ -198,9 +198,7 @@ async def trace_middleware(request: web.Request, handler):
                 span.set_attribute("http_status", e.status)
                 e.headers["x-trace-id"] = span.trace_id
                 raise
-            except Exception as e:  # noqa: BLE001 — unexpected: a JSON
-                # 500 with the trace id beats an opaque text 500 the
-                # operator can't correlate to a trace.
+            except Exception as e:  # sublint: allow[broad-except]: last-resort handler — a JSON 500 with the trace id beats an opaque text 500
                 logging.getLogger(__name__).exception(
                     "unhandled error serving %s", request.path
                 )
@@ -301,7 +299,7 @@ def build_app(state: ServerState) -> web.Application:
 
             jax.profiler.start_trace  # attribute probe
             return jax.profiler
-        except Exception:  # noqa: BLE001 — any import/attr failure = absent
+        except Exception:  # sublint: allow[broad-except]: any import/attr failure means the profiler is absent; endpoint answers no-op
             return None
 
     def _profile_dir() -> str:
@@ -318,8 +316,7 @@ def build_app(state: ServerState) -> web.Application:
             task.cancel()
         try:
             prof.stop_trace()
-        except Exception as e:  # noqa: BLE001 — a capture that failed to
-            # start must still be clearable
+        except Exception as e:  # sublint: allow[broad-except]: a capture that failed to start must still be clearable; error surfaces in the response
             info["stop_error"] = str(e)
         elapsed = round(time.perf_counter() - info.pop("t0"), 3)
         with tracer.span(
@@ -381,7 +378,7 @@ def build_app(state: ServerState) -> web.Application:
             out_dir = _profile_dir()
             try:
                 prof.start_trace(out_dir)
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # sublint: allow[broad-except]: profiler backends raise anything; converted to a 500 with the message
                 raise web.HTTPInternalServerError(
                     text=f"profiler failed to start: {e}"
                 )

@@ -346,9 +346,9 @@ class Trainer:
         if nproc > 1:
             batch = jax.tree.map(
                 lambda x: jax.make_array_from_process_local_data(
-                    self.batch_sharding, np.asarray(x),
+                    self.batch_sharding, np.asarray(x),  # sublint: allow[hostsync]: incoming batch is host data; numpy is what every process can feed identically
                     global_shape=(
-                        np.asarray(x).shape if batch_is_global else None
+                        np.asarray(x).shape if batch_is_global else None  # sublint: allow[hostsync]: same host-side batch, shape probe only
                     ),
                 ),
                 batch,

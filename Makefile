@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare graft image install-manifests
+.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -73,6 +73,21 @@ bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --gang 2 \
 	  --transport tcp --long-admission 8200 \
 	  | $(PY) hack/bench_compare.py --validate -
+
+# Gateway chaos smoke: 2 in-process CPU replicas behind the routing
+# gateway, scripted kill mid-stream / hedge / recover-after-backoff
+# (tools/gateway_smoke.py; the pytest chaos test drives the same
+# harness). JSON verdict on stdout, nonzero exit on any stage failing.
+gateway-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/gateway_smoke.py
+
+# Routed-2-replica vs direct throughput/TTFT capture (ISSUE 5
+# acceptance: routed aggregate tok/s >= 1.7x single replica on the
+# smoke shape). Spawns replica server subprocesses; heavier than
+# gateway-smoke, so not part of the CI tests workflow.
+gateway-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --gateway 2 \
+	  --max-tokens 32 | $(PY) hack/bench_compare.py --validate -
 
 # Bench JSON schema + >10% regression gate (hack/bench_compare.py):
 # self-tests that a synthetic 20% regression fails and that the repo's

@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     # Register every plane's declarations (import side effects only).
     import substratus_tpu.controller.runtime  # noqa: F401
+    import substratus_tpu.gateway.router  # noqa: F401
     import substratus_tpu.sci.client as sci
     import substratus_tpu.serve.engine  # noqa: F401
     import substratus_tpu.serve.server  # noqa: F401
@@ -36,6 +37,20 @@ def main() -> int:
     )
     METRICS.set("substratus_workqueue_depth", 3)
     METRICS.observe("substratus_reconcile_seconds", 0.012, {"kind": "Model"})
+    # Gateway plane: the shared HTTP counter + per-replica series whose
+    # label values carry URL characters (scheme colon, slashes).
+    METRICS.inc(
+        "substratus_http_requests_total",
+        {"endpoint": "/v1/completions", "code": "429"},
+    )
+    METRICS.set(
+        "substratus_gateway_inflight", 2, {"replica": "http://r0:8080"}
+    )
+    METRICS.inc("substratus_gateway_sheds_total", {"reason": "ratelimit"})
+    METRICS.inc(
+        "substratus_gateway_ejections_total", {"replica": "http://r0:8080"}
+    )
+    METRICS.observe("substratus_gateway_upstream_seconds", 0.05)
     client = sci.FakeSCIClient()
     client.get_object_md5("gs://bucket", "obj")
     client.create_signed_url("gs://bucket", "obj", "d41d8cd9")

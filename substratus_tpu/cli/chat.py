@@ -19,8 +19,27 @@ from __future__ import annotations
 import json
 import sys
 import time
+import urllib.error
 import urllib.request
 from typing import List, Optional
+
+# Load-shed retry policy: 429/503 with Retry-After is the serving
+# tier TELLING us when to come back (gateway/server admission control,
+# docs/serving.md "Shedding"); honoring it beats failing the turn.
+RETRY_STATUSES = (429, 503)
+MAX_RETRIES = 3
+MAX_RETRY_AFTER_S = 30.0
+
+
+def _retry_after_s(err: "urllib.error.HTTPError") -> float:
+    """The server's Retry-After in seconds, clamped sane; 1 s when the
+    header is absent or unparseable (HTTP-date form included — not
+    worth a date parser for a sleep hint)."""
+    raw = (err.headers.get("Retry-After") or "").strip()
+    try:
+        return min(MAX_RETRY_AFTER_S, max(0.0, float(raw)))
+    except ValueError:
+        return 1.0
 
 
 ANSI_USER = "\x1b[36m"     # cyan
@@ -70,7 +89,23 @@ def stream_chat(
             data=body,
             headers=inject_headers({"Content-Type": "application/json"}),
         )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp = None
+        for attempt in range(1 + MAX_RETRIES):
+            try:
+                resp = urllib.request.urlopen(req, timeout=timeout)
+                break
+            except urllib.error.HTTPError as e:
+                # A shed (429/503) names its own comeback time; anything
+                # else propagates to the REPL's error handling.
+                if e.code not in RETRY_STATUSES or attempt == MAX_RETRIES:
+                    raise
+                wait = _retry_after_s(e)
+                span.set_attribute("retried_after_s", wait)
+                sys.stderr.write(
+                    f"(server busy, retrying in {wait:.0f}s)\n"
+                )
+                time.sleep(wait)
+        with resp:
             server_trace = resp.headers.get("x-trace-id")
             if server_trace:
                 span.set_attribute("server_trace_id", server_trace)

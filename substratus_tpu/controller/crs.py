@@ -397,6 +397,7 @@ class ServerReconciler(BaseReconciler):
         if pod["_slice"]["num_hosts"] > 1:
             return self._reconcile_multihost(obj, pod)
         replicas = int((obj.get("spec") or {}).get("params", {}).get("replicas", 1))
+        engine_selector = {"substratus.ai/object": f"server-{md['name']}"}
         deployment: Obj = {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
@@ -415,6 +416,31 @@ class ServerReconciler(BaseReconciler):
                 "template": {"metadata": pod["metadata"], "spec": pod["spec"]},
             },
         }
+        # replicas > 1: a plain k8s Service would round-robin blind —
+        # no backpressure, no load shedding, broken streams on replica
+        # loss. Put the routing tier in front (docs/serving.md) and
+        # keep the client-facing Service NAME stable by repointing its
+        # selector at the gateway pods.
+        front_selector = dict(engine_selector)
+        gateway_ready = True
+        if replicas > 1:
+            from substratus_tpu.controller.workloads import (
+                serving_gateway_workloads,
+            )
+
+            front_selector = {
+                "substratus.ai/object": f"server-gateway-{md['name']}"
+            }
+            gw_live = [
+                reconcile_child(self.client, w)
+                for w in serving_gateway_workloads(
+                    obj, f"{md['name']}-server",
+                    (obj.get("spec") or {}).get("image"), engine_selector,
+                )
+            ]
+            gateway_ready = (
+                gw_live[-1].get("status", {}).get("readyReplicas") or 0
+            ) > 0
         service: Obj = {
             "apiVersion": "v1",
             "kind": "Service",
@@ -424,15 +450,21 @@ class ServerReconciler(BaseReconciler):
                 "ownerReferences": [owner_reference(obj)],
             },
             "spec": {
-                "selector": {"substratus.ai/object": f"server-{md['name']}"},
+                "selector": front_selector,
                 "ports": [
                     {"port": 8080, "targetPort": "http-serve", "name": "http"}
                 ],
             },
         }
+        if replicas > 1:
+            # The gateway container port is named http-gw.
+            service["spec"]["ports"][0]["targetPort"] = "http-gw"
         reconcile_child(self.client, service)
         live = reconcile_child(self.client, deployment)
-        ready = (live.get("status", {}).get("readyReplicas") or 0) > 0
+        ready = (
+            (live.get("status", {}).get("readyReplicas") or 0) > 0
+            and gateway_ready
+        )
         obj.setdefault("status", {})["ready"] = ready
         set_condition(
             obj, C.CONDITION_SERVING, ready,

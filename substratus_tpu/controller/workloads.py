@@ -329,6 +329,98 @@ def workload_for_pod(obj: Obj, pod: Dict[str, Any], backoff_limit: int) -> List[
     return [job_from_pod(obj, pod, backoff_limit)]
 
 
+GATEWAY_COMMAND = ["python", "-m", "substratus_tpu.gateway.main"]
+
+
+def replicas_service_name(front_name: str) -> str:
+    """Headless Service enumerating the engine replica pods — the DNS
+    name the gateway's --discover loop re-resolves."""
+    return f"{front_name}-replicas"
+
+
+def gateway_name(front_name: str) -> str:
+    return f"{front_name}-gateway"
+
+
+def serving_gateway_workloads(
+    obj: Obj, front_name: str, image: str, engine_selector: Dict[str, str],
+) -> List[Obj]:
+    """The routing tier for a replicated single-host Server
+    (docs/serving.md "Serving gateway"): [headless replicas Service,
+    gateway Deployment]. The caller repoints the front Service at the
+    gateway pods, so the client address never changes when `replicas`
+    crosses 1.
+
+    The gateway is jax-free and stateless: one replica suffices for
+    correctness (it restarts in milliseconds), and its Deployment
+    scales independently of the engines if the HTTP tier ever
+    saturates. `publishNotReadyAddresses` stays FALSE on the replicas
+    Service: DNS only hands the gateway pods that passed the engine
+    readiness probe; the gateway's own circuit breaker handles the
+    ready-but-dying window."""
+    md = obj["metadata"]
+    ns = md["namespace"]
+    gw_labels = {
+        "app.kubernetes.io/managed-by": "substratus-tpu",
+        "substratus.ai/object": f"server-gateway-{md['name']}",
+    }
+    replicas_svc: Obj = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": replicas_service_name(front_name),
+            "namespace": ns,
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": dict(engine_selector),
+            "ports": [
+                {"port": 8080, "targetPort": "http-serve", "name": "http"}
+            ],
+        },
+    }
+    container: Dict[str, Any] = {
+        "name": "gateway",
+        "image": image,
+        "command": list(GATEWAY_COMMAND),
+        "args": [
+            "--port", "8080",
+            "--discover",
+            f"{replicas_service_name(front_name)}.{ns}.svc:8080",
+        ],
+        "env": [{"name": "TRACEPARENT", "value": workload_traceparent(obj)}],
+        "ports": [{"containerPort": 8080, "name": "http-gw"}],
+        "readinessProbe": {
+            # Gateway readiness = "at least one routable replica":
+            # clients only reach a gateway that can actually serve.
+            "httpGet": {"path": "/", "port": 8080},
+            "initialDelaySeconds": 1,
+            "periodSeconds": 5,
+        },
+    }
+    deployment: Obj = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": gateway_name(front_name),
+            "namespace": ns,
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {
+                "substratus.ai/object": gw_labels["substratus.ai/object"]
+            }},
+            "template": {
+                "metadata": {"labels": dict(gw_labels)},
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+    return [replicas_svc, deployment]
+
+
 def serving_gang_name(front_name: str) -> str:
     """JobSet/headless-Service name for a multi-host serving gang whose
     client-facing front Service is `front_name`."""

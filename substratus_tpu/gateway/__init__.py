@@ -1,0 +1,31 @@
+"""Serving gateway: replica-aware routing, admission control, load
+shedding (docs/serving.md).
+
+Fronts N engine replicas with least-loaded power-of-two-choices
+routing fed by the engine load-report protocol, per-key token-bucket
+admission, bounded per-replica in-flight windows, deadline
+propagation, circuit-breaker ejection with exponential backoff, and
+hedged retries for requests that lose their replica before any byte
+reaches the client. jax-free by design.
+"""
+from substratus_tpu.gateway.balancer import Balancer, Replica
+from substratus_tpu.gateway.health import CircuitBreaker
+from substratus_tpu.gateway.limiter import KeyedLimiter, TokenBucket
+from substratus_tpu.gateway.loadreport import LoadReport
+from substratus_tpu.gateway.router import (
+    Gateway,
+    GatewayConfig,
+    build_gateway_app,
+)
+
+__all__ = [
+    "Balancer",
+    "CircuitBreaker",
+    "Gateway",
+    "GatewayConfig",
+    "KeyedLimiter",
+    "LoadReport",
+    "Replica",
+    "TokenBucket",
+    "build_gateway_app",
+]

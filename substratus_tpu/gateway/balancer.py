@@ -1,0 +1,159 @@
+"""Replica set + routing policy: least-loaded power-of-two-choices.
+
+Routing combines two signals per replica:
+
+  * the gateway's OWN in-flight count — exact, instant, but blind to
+    load arriving through other gateways or direct clients;
+  * the replica's last LoadReport (header-piggybacked or polled) —
+    global truth, but stale by up to one report interval.
+
+Power-of-two-choices over that combined score gets within a constant
+factor of full least-loaded routing while keeping herd behavior out:
+when every gateway deterministically picks the globally least-loaded
+replica from the same stale snapshot, they all dogpile it; sampling
+two and taking the better one provably avoids that (the classic
+balls-into-bins result ParvaGPU's cluster tier leans on too).
+
+Admission windows: a replica stops being eligible once the gateway has
+`max_inflight` requests outstanding on it — bounded per-replica
+in-flight beats unbounded proxy queues, and "no eligible replica"
+is the router's load-shedding signal (503 + Retry-After).
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional
+
+from substratus_tpu.gateway.health import CircuitBreaker
+from substratus_tpu.gateway.loadreport import LoadReport
+
+
+class Replica:
+    def __init__(self, url: str, max_inflight: int = 32,
+                 backoff_base: float = 0.5, backoff_cap: float = 30.0):
+        self.url = url.rstrip("/")
+        self.max_inflight = max_inflight
+        self.inflight = 0
+        self.report = LoadReport()
+        self.circuit = CircuitBreaker(
+            backoff_base=backoff_base, backoff_cap=backoff_cap
+        )
+
+    def score(self) -> float:
+        """Lower = preferred. Local in-flight is the freshest signal;
+        the report adds cross-gateway visibility."""
+        return self.inflight + self.report.score()
+
+    def snapshot(self, now: float) -> dict:
+        return {
+            "url": self.url,
+            "inflight": self.inflight,
+            "max_inflight": self.max_inflight,
+            "available": self.circuit.available(now),
+            "ejected_for_s": max(
+                0.0, round(self.circuit.ejected_until - now, 3)
+            ),
+            "consecutive_failures": self.circuit.consecutive_failures,
+            "ejections": self.circuit.ejections,
+            "report": {
+                "queue_depth": self.report.queue_depth,
+                "active_slots": self.report.active_slots,
+                "max_slots": self.report.max_slots,
+                "kv_free_frac": round(self.report.kv_free_frac, 3),
+                "age_s": round(now - self.report.ts, 3),
+            },
+        }
+
+
+class Balancer:
+    """The replica table. Single event loop owner: the router calls
+    everything from one asyncio loop, so there is no locking — adding
+    threads here would need one."""
+
+    def __init__(self, urls: List[str], max_inflight: int = 32,
+                 backoff_base: float = 0.5, backoff_cap: float = 30.0,
+                 seed: Optional[int] = None):
+        self.replicas: Dict[str, Replica] = {}
+        self._rng = random.Random(seed)
+        self._max_inflight = max_inflight
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
+        for u in urls:
+            self.add(u)
+
+    def add(self, url: str) -> Replica:
+        url = url.rstrip("/")
+        rep = self.replicas.get(url)
+        if rep is None:
+            rep = self.replicas[url] = Replica(
+                url, self._max_inflight,
+                backoff_base=self._backoff_base,
+                backoff_cap=self._backoff_cap,
+            )
+        return rep
+
+    def remove(self, url: str) -> None:
+        self.replicas.pop(url.rstrip("/"), None)
+
+    # -- routing -----------------------------------------------------------
+
+    def eligible(self, now: Optional[float] = None,
+                 exclude: tuple = ()) -> List[Replica]:
+        now = time.monotonic() if now is None else now
+        return [
+            r for r in self.replicas.values()
+            if r.url not in exclude
+            and r.circuit.available(now)
+            and r.inflight < r.max_inflight
+        ]
+
+    def pick(self, now: Optional[float] = None,
+             exclude: tuple = ()) -> Optional[Replica]:
+        """Power-of-two-choices among eligible replicas; None = shed.
+        `exclude` carries the urls a hedged retry already failed on."""
+        cands = self.eligible(now, exclude)
+        if not cands:
+            return None
+        if len(cands) <= 2:
+            return min(cands, key=lambda r: r.score())
+        a, b = self._rng.sample(cands, 2)
+        return a if a.score() <= b.score() else b
+
+    def saturated(self, now: Optional[float] = None) -> bool:
+        """Every replica healthy-but-full: the shed should say 'soon'
+        (Retry-After ~ a decode wave), not 'back off hard'."""
+        now = time.monotonic() if now is None else now
+        live = [
+            r for r in self.replicas.values() if r.circuit.available(now)
+        ]
+        return bool(live) and all(
+            r.inflight >= r.max_inflight for r in live
+        )
+
+    # -- bookkeeping (router calls around each proxied request) ------------
+
+    def acquire(self, rep: Replica) -> None:
+        rep.inflight += 1
+
+    def release(self, rep: Replica) -> None:
+        rep.inflight = max(0, rep.inflight - 1)
+
+    def observe_report(self, rep: Replica, report: LoadReport) -> None:
+        rep.report = report
+
+    def observe_success(self, rep: Replica) -> None:
+        rep.circuit.record_success()
+
+    def observe_failure(self, rep: Replica,
+                        now: Optional[float] = None) -> float:
+        return rep.circuit.record_failure(
+            time.monotonic() if now is None else now
+        )
+
+    def snapshot(self, now: Optional[float] = None) -> List[dict]:
+        now = time.monotonic() if now is None else now
+        return [
+            r.snapshot(now)
+            for r in sorted(self.replicas.values(), key=lambda r: r.url)
+        ]

@@ -1,0 +1,55 @@
+"""Per-replica health and circuit state.
+
+Failure policy: transport-level failures (connect refused, reset,
+timeout) eject the replica for an exponentially growing backoff window
+— 0.5 s, 1 s, 2 s, ... capped at 30 s — because a replica that just
+dropped a connection is overwhelmingly likely to drop the next one too,
+and every request sent there during the outage pays a full connect
+timeout. After the window the circuit is HALF-OPEN: the replica is
+eligible again, one success closes the circuit (counter resets), one
+failure re-ejects with the doubled window. Application-level responses
+never eject (a 429/503 is the replica TALKING — shedding by contract,
+not dead); they only steer the balancer via the load report.
+
+All times are caller-supplied monotonic seconds so tests drive the
+clock; nothing here sleeps or threads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CircuitBreaker:
+    backoff_base: float = 0.5  # first ejection window (seconds)
+    backoff_cap: float = 30.0
+    consecutive_failures: int = 0
+    ejected_until: float = 0.0  # monotonic deadline; 0 = closed
+    ejections: int = 0  # lifetime count (metrics)
+
+    def available(self, now: float) -> bool:
+        """Eligible for traffic: circuit closed, or backoff expired
+        (half-open trial)."""
+        return now >= self.ejected_until
+
+    @property
+    def half_open(self) -> bool:
+        """A past ejection whose window lapsed without a success yet —
+        the next request is the trial."""
+        return self.consecutive_failures > 0
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self.ejected_until = 0.0
+
+    def record_failure(self, now: float) -> float:
+        """Transport failure: eject with exponential backoff. Returns
+        the backoff window just applied (seconds)."""
+        self.consecutive_failures += 1
+        window = min(
+            self.backoff_cap,
+            self.backoff_base * (2 ** (self.consecutive_failures - 1)),
+        )
+        self.ejected_until = now + window
+        self.ejections += 1
+        return window

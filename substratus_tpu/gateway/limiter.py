@@ -1,0 +1,136 @@
+"""Admission control primitives: per-key token buckets and request
+deadlines.
+
+Token buckets are per API key (the `Authorization: Bearer` token or
+`x-api-key` header; anonymous traffic shares one bucket) so a single
+misbehaving client saturates its own budget, not the cluster. Refill is
+lazy — computed from elapsed time at each `allow` — so an idle gateway
+spends nothing, and idle buckets are pruned.
+
+Deadlines ride the `x-request-deadline` header as ABSOLUTE unix epoch
+seconds (float). Absolute beats relative across hops: a relative
+timeout would need re-decrementing at every tier and silently resets on
+retries, while an absolute deadline shrinks monotonically no matter how
+many replicas a hedged request visits. Clients that prefer relative
+send `x-request-timeout: <seconds>`; the gateway converts once at the
+edge. (Clock skew caveat documented in docs/serving.md — within one
+cluster NTP keeps this well under typical deadlines.)
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+DEADLINE_HEADER = "x-request-deadline"
+TIMEOUT_HEADER = "x-request-timeout"
+
+
+class TokenBucket:
+    """Classic token bucket: `rate` tokens/second, `burst` capacity."""
+
+    def __init__(self, rate: float, burst: float,
+                 now: Optional[float] = None):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.updated = time.monotonic() if now is None else now
+
+    def allow(self, now: Optional[float] = None,
+              cost: float = 1.0) -> Tuple[bool, float]:
+        """(allowed, retry_after_seconds). retry_after is how long until
+        `cost` tokens will have refilled — the Retry-After a 429 sends."""
+        now = time.monotonic() if now is None else now
+        self.tokens = min(
+            self.burst,
+            self.tokens + max(0.0, now - self.updated) * self.rate,
+        )
+        self.updated = now
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True, 0.0
+        needed = cost - self.tokens
+        return False, needed / self.rate if self.rate > 0 else 60.0
+
+
+class KeyedLimiter:
+    """Per-key token buckets with idle pruning. rate <= 0 disables the
+    limiter entirely (allow always passes) — the local-dev default."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 max_keys: int = 4096):
+        self.rate = rate
+        self.burst = burst if burst is not None else max(1.0, rate)
+        self.max_keys = max_keys
+        self.buckets: Dict[str, TokenBucket] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate > 0
+
+    def allow(self, key: str,
+              now: Optional[float] = None) -> Tuple[bool, float]:
+        if not self.enabled:
+            return True, 0.0
+        now = time.monotonic() if now is None else now
+        bucket = self.buckets.get(key)
+        if bucket is None:
+            if len(self.buckets) >= self.max_keys:
+                self._prune(now)
+            bucket = self.buckets[key] = TokenBucket(
+                self.rate, self.burst, now=now
+            )
+        return bucket.allow(now)
+
+    def _prune(self, now: float) -> None:
+        """Drop buckets idle long enough to be full again (they carry no
+        information a fresh bucket wouldn't)."""
+        idle = self.burst / self.rate if self.rate > 0 else 0.0
+        for key in [
+            k for k, b in self.buckets.items()
+            if now - b.updated > idle
+        ]:
+            del self.buckets[key]
+        # Pathological case: every bucket hot. Evict oldest-touched.
+        while len(self.buckets) >= self.max_keys:
+            oldest = min(self.buckets, key=lambda k: self.buckets[k].updated)
+            del self.buckets[oldest]
+
+
+def api_key_of(headers) -> str:
+    """The rate-limit key for a request: bearer token, x-api-key, or the
+    shared anonymous bucket."""
+    auth = headers.get("Authorization", "")
+    if auth.lower().startswith("bearer "):
+        return auth[7:].strip() or "anonymous"
+    return headers.get("x-api-key") or "anonymous"
+
+
+def parse_deadline(headers,
+                   default_timeout: float = 0.0) -> Optional[float]:
+    """Absolute unix-seconds deadline for a request, or None.
+
+    Precedence: explicit x-request-deadline, then x-request-timeout
+    (relative, converted here), then the configured default timeout
+    (0 = no deadline)."""
+    raw = headers.get(DEADLINE_HEADER)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass  # malformed header: fall through, don't reject
+    raw = headers.get(TIMEOUT_HEADER)
+    if raw:
+        try:
+            return time.time() + max(0.0, float(raw))
+        except ValueError:
+            pass
+    if default_timeout > 0:
+        return time.time() + default_timeout
+    return None
+
+
+def deadline_remaining(deadline: Optional[float]) -> Optional[float]:
+    """Seconds left (may be <= 0: already expired); None = no deadline."""
+    if deadline is None:
+        return None
+    return deadline - time.time()

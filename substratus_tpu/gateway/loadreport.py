@@ -1,0 +1,86 @@
+"""Load-report protocol between engine replicas and the gateway.
+
+A replica's load is four cheap host-side numbers the engine already
+tracks (no device read, no lock): waiting-queue depth, occupied decode
+slots, the slot ceiling, and the free fraction of the KV pool. The
+server exposes the snapshot two ways:
+
+  * `GET /loadz` — pull: the gateway's poller and k8s-style readiness
+    checks (a draining server answers 503, which is how the gateway
+    learns a replica is leaving BEFORE its streams finish);
+  * `x-substratus-load` response header — push: stamped on every
+    completion response, so a gateway routing live traffic learns each
+    replica's load passively at the rate it talks to it, with zero
+    extra round trips.
+
+The header value is a comma-joined `k=v` list (`q=3 a=2 m=8 kvf=0.75`
+shaped), chosen over JSON so it never needs quoting inside an HTTP
+header and stays greppable in access logs.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+HEADER = "x-substratus-load"
+
+
+@dataclass
+class LoadReport:
+    """One replica's load snapshot, as routed on."""
+
+    queue_depth: int = 0  # requests waiting for a decode slot
+    active_slots: int = 0  # slots currently generating
+    max_slots: int = 1  # configured decode slot ceiling (max_batch)
+    kv_free_frac: float = 1.0  # free fraction of the KV pool [0, 1]
+    # Stamped by the RECEIVER (gateway clock): reports age out rather
+    # than mislead — a 30 s old "idle" beats routing storms.
+    ts: float = field(default_factory=time.monotonic)
+
+    def score(self) -> float:
+        """Routing score: lower = less loaded. Queue depth dominates
+        (each queued request is a whole forthcoming batch residency),
+        slot occupancy breaks ties, KV pressure nudges away from
+        replicas about to preempt."""
+        occupancy = self.active_slots / max(1, self.max_slots)
+        kv_pressure = 1.0 - self.kv_free_frac
+        return 2.0 * self.queue_depth + occupancy + 0.5 * kv_pressure
+
+    def to_header(self) -> str:
+        return (
+            f"q={self.queue_depth} a={self.active_slots} "
+            f"m={self.max_slots} kvf={self.kv_free_frac:.3f}"
+        )
+
+    @classmethod
+    def from_header(cls, value: str) -> "LoadReport":
+        """Parse a header value; unknown keys ignored, malformed fields
+        fall back to the defaults (a half-parsed report still beats no
+        report)."""
+        kv = {}
+        for part in value.replace(",", " ").split():
+            if "=" not in part:
+                continue
+            k, _, v = part.partition("=")
+            try:
+                kv[k] = float(v)
+            except ValueError:
+                continue
+        return cls(
+            queue_depth=int(kv.get("q", 0)),
+            active_slots=int(kv.get("a", 0)),
+            max_slots=max(1, int(kv.get("m", 1))),
+            kv_free_frac=min(1.0, max(0.0, kv.get("kvf", 1.0))),
+        )
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "LoadReport":
+        """From the engine's load_snapshot() dict (the /loadz body)."""
+        return cls(
+            queue_depth=int(snap.get("queue_depth", 0)),
+            active_slots=int(snap.get("active_slots", 0)),
+            max_slots=max(1, int(snap.get("max_slots", 1))),
+            kv_free_frac=min(
+                1.0, max(0.0, float(snap.get("kv_free_frac", 1.0)))
+            ),
+        )

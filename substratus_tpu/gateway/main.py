@@ -1,0 +1,176 @@
+"""Gateway container entrypoint.
+
+    python -m substratus_tpu.gateway.main --replicas http://a:8080,http://b:8080
+    python -m substratus_tpu.gateway.main --discover my-server-replicas:8080
+
+`--discover` takes a DNS name (the controller passes the engine
+Deployment's headless Service) and re-resolves it periodically, so
+scale-up/down and pod churn flow into the replica table without a
+restart; `--replicas` is the static list for local runs and tests.
+
+Deliberately jax-free: the gateway routes bytes, it never touches a
+model, so it starts in milliseconds and its Deployment can scale
+independently of the engine replicas.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+from typing import List, Optional
+
+from aiohttp import web
+
+from substratus_tpu.gateway.router import (
+    Gateway,
+    GatewayConfig,
+    build_gateway_app,
+)
+
+log = logging.getLogger("substratus.gateway")
+
+
+async def resolve_replicas(name: str, port: int) -> List[str]:
+    """DNS name -> replica urls (one per A/AAAA record — a headless
+    Service resolves to every ready pod)."""
+    loop = asyncio.get_running_loop()
+    try:
+        infos = await loop.getaddrinfo(name, port, type=0)
+    except OSError:
+        return []
+    urls = []
+    for _, _, _, _, sockaddr in infos:
+        host = sockaddr[0]
+        if ":" in host:  # IPv6 literal
+            host = f"[{host}]"
+        urls.append(f"http://{host}:{port}")
+    return sorted(set(urls))
+
+
+async def discover_loop(gw: Gateway, name: str, port: int,
+                        interval: float) -> None:
+    """Sync the balancer's replica set with DNS. Known-but-gone replicas
+    are removed only when DNS answered (an empty answer on a resolver
+    blip must not dump the whole table)."""
+    while True:
+        urls = await resolve_replicas(name, port)
+        if urls:
+            for u in urls:
+                gw.balancer.add(u)
+            for u in list(gw.balancer.replicas):
+                if u not in urls:
+                    gw.balancer.remove(u)
+        await asyncio.sleep(interval)
+
+
+async def run_gateway(gw: Gateway, host: str, port: int,
+                      discover: Optional[str] = None,
+                      discover_interval: float = 5.0,
+                      ready_event: Optional[asyncio.Event] = None,
+                      stop_event: Optional[asyncio.Event] = None) -> None:
+    """Serve until SIGTERM/SIGINT (or `stop_event` for embedders)."""
+    app = build_gateway_app(gw)
+    runner = web.AppRunner(app, handle_signals=False)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+
+    tasks = []
+    if discover:
+        name, _, dport = discover.partition(":")
+        tasks.append(asyncio.get_running_loop().create_task(
+            discover_loop(
+                gw, name, int(dport or 8080), discover_interval
+            )
+        ))
+    stop = stop_event or asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix event loops
+            pass
+    log.info("gateway on %s:%s (%d replicas)", host, port,
+             len(gw.balancer.replicas))
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        await stop.wait()
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        await runner.cleanup()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument(
+        "--replicas", default="",
+        help="comma-separated replica base urls (static set)",
+    )
+    ap.add_argument(
+        "--discover", default="",
+        help="DNS name[:port] re-resolved into the replica set "
+             "(headless Service of the engine Deployment)",
+    )
+    ap.add_argument("--discover-interval", type=float, default=5.0)
+    ap.add_argument(
+        "--max-inflight", type=int,
+        default=int(os.environ.get("GATEWAY_MAX_INFLIGHT", 32)),
+        help="per-replica in-flight window; beyond it requests shed",
+    )
+    ap.add_argument(
+        "--rate", type=float,
+        default=float(os.environ.get("GATEWAY_RATE", 0)),
+        help="per-API-key requests/second (0 = rate limiting off)",
+    )
+    ap.add_argument("--burst", type=float, default=None)
+    ap.add_argument(
+        "--default-timeout", type=float,
+        default=float(os.environ.get("GATEWAY_DEFAULT_TIMEOUT", 0)),
+        help="deadline stamped on requests that carry none (seconds; "
+             "0 = unbounded)",
+    )
+    ap.add_argument("--poll-interval", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    # Join the spawner's trace (controller-stamped TRACEPARENT) the same
+    # way serve.main does, and honor the JSONL span export for lint.
+    from substratus_tpu.observability.propagation import context_from_env
+    from substratus_tpu.observability.tracing import tracer
+
+    with tracer.span("gateway.start", parent=context_from_env()):
+        pass
+    trace_export = os.environ.get("SUBSTRATUS_TRACE_EXPORT")
+    if trace_export:
+        import atexit
+
+        atexit.register(tracer.export_jsonl, trace_export)
+
+    urls = [u for u in args.replicas.split(",") if u.strip()]
+    if not urls and not args.discover:
+        raise SystemExit("gateway: need --replicas or --discover")
+    gw = Gateway(urls, GatewayConfig(
+        max_inflight=args.max_inflight,
+        rate=args.rate,
+        burst=args.burst,
+        default_timeout=args.default_timeout,
+        poll_interval=args.poll_interval,
+    ))
+    asyncio.run(run_gateway(
+        gw, args.host, args.port,
+        discover=args.discover or None,
+        discover_interval=args.discover_interval,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,557 @@
+"""The routing tier: an async HTTP proxy fronting N engine replicas.
+
+Request path (docs/serving.md "Serving gateway"):
+
+  1. **admission** — per-key token bucket (limiter.py): over budget is
+     429 + Retry-After, before any replica work. Expired deadlines are
+     shed as 504 (the client already gave up; serving it wastes a slot).
+  2. **routing** — least-loaded power-of-two-choices over replicas that
+     are circuit-closed and under their in-flight window (balancer.py);
+     no eligible replica is 503 + Retry-After, never an unbounded queue.
+  3. **proxying** — the request is forwarded with the W3C traceparent of
+     the `gateway.route` span and the absolute `x-request-deadline`, so
+     the replica's spans join the trace and its own admission can honor
+     the same deadline.
+  4. **hedged retries** — a request that loses its replica (connect
+     refused, reset, timeout) before any byte reached the client is
+     replayed on another replica; the failed replica is ejected with
+     exponential backoff (health.py). A replica answering 429/503 is
+     NOT ejected (it is shedding by contract) but the request does try
+     the others. Once bytes have streamed, a dead upstream ends the SSE
+     with a well-formed error event + [DONE] instead of a hang.
+  5. **learning** — every replica response carries `x-substratus-load`
+     (loadreport.py); the router feeds it to the balancer, and a
+     background poller hits `/loadz` so idle or recovering replicas
+     stay visible.
+
+Everything runs on one event loop; replica engines live in other
+processes (or in-process test servers) behind plain HTTP.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from substratus_tpu.gateway.balancer import Balancer, Replica
+from substratus_tpu.gateway.limiter import (
+    DEADLINE_HEADER,
+    KeyedLimiter,
+    api_key_of,
+    deadline_remaining,
+    parse_deadline,
+)
+from substratus_tpu.gateway.loadreport import HEADER as LOAD_HEADER
+from substratus_tpu.gateway.loadreport import LoadReport
+from substratus_tpu.observability.httpstats import count_http_response
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.propagation import (
+    format_traceparent,
+    parse_traceparent,
+)
+from substratus_tpu.observability.tracing import tracer
+
+log = logging.getLogger("substratus.gateway")
+
+# Gateway metric catalog (docs/observability.md "Gateway"). The
+# requests_total family is shared with serve/server.py through
+# observability/httpstats.py — one name, one scrape query for shed
+# rate across both tiers.
+METRICS.describe(
+    "substratus_gateway_inflight",
+    "Requests this gateway currently has outstanding on a replica.",
+    type="gauge",
+)
+METRICS.describe(
+    "substratus_gateway_ejections_total",
+    "Circuit-breaker ejections after transport failures, by replica.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_gateway_sheds_total",
+    "Requests shed instead of queued, by reason "
+    "(ratelimit, deadline, no_replica, saturated).", type="counter",
+)
+METRICS.describe(
+    "substratus_gateway_hedges_total",
+    "Requests replayed on another replica after losing theirs.",
+    type="counter",
+)
+METRICS.histogram(
+    "substratus_gateway_upstream_seconds",
+    "Wall time of one upstream attempt (connect to last byte), "
+    "successful attempts only.",
+)
+
+# Transport-level failures that mean "the replica is gone", as opposed
+# to it answering with an error status.
+_TRANSPORT_ERRORS = (
+    aiohttp.ClientConnectionError,  # covers refused/reset/disconnected
+    aiohttp.ClientPayloadError,
+    asyncio.TimeoutError,
+    ConnectionResetError,
+)
+
+
+class _ClientGone(Exception):
+    """The CLIENT disconnected mid-relay. Routine (ctrl-C, timeouts on
+    the caller's side) and says nothing about the replica — it must
+    never eject or hedge, only end the relay quietly."""
+
+
+@web.middleware
+async def counting_middleware(request: web.Request, handler):
+    """substratus_http_requests_total on EVERY gateway response — the
+    shed-rate denominator (docs/observability.md)."""
+    try:
+        resp = await handler(request)
+    except web.HTTPException as e:
+        count_http_response(request.path, e.status)
+        raise
+    except Exception:
+        count_http_response(request.path, 500)
+        raise
+    count_http_response(request.path, resp.status)
+    return resp
+
+
+class GatewayConfig:
+    def __init__(
+        self,
+        max_inflight: int = 32,  # per-replica in-flight window
+        rate: float = 0.0,  # per-key requests/sec (0 = limiter off)
+        burst: Optional[float] = None,
+        default_timeout: float = 0.0,  # default deadline (0 = none)
+        connect_timeout: float = 2.0,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 30.0,
+        poll_interval: float = 2.0,  # /loadz poll (0 = off)
+        max_hedges: int = 3,  # replays per request on replica loss
+        shed_retry_after: float = 1.0,  # Retry-After when saturated
+    ):
+        self.max_inflight = max_inflight
+        self.rate = rate
+        self.burst = burst
+        self.default_timeout = default_timeout
+        self.connect_timeout = connect_timeout
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.poll_interval = poll_interval
+        self.max_hedges = max_hedges
+        self.shed_retry_after = shed_retry_after
+
+
+class Gateway:
+    """Router state: balancer + limiter + the shared client session."""
+
+    def __init__(self, urls, cfg: Optional[GatewayConfig] = None,
+                 seed: Optional[int] = None):
+        self.cfg = cfg or GatewayConfig()
+        self.balancer = Balancer(
+            urls, max_inflight=self.cfg.max_inflight,
+            backoff_base=self.cfg.backoff_base,
+            backoff_cap=self.cfg.backoff_cap, seed=seed,
+        )
+        self.limiter = KeyedLimiter(self.cfg.rate, self.cfg.burst)
+        self.session: Optional[aiohttp.ClientSession] = None
+        self._poll_task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self.session = aiohttp.ClientSession()
+        if self.cfg.poll_interval > 0:
+            self._poll_task = asyncio.get_running_loop().create_task(
+                self._poll_loop()
+            )
+
+    async def close(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except asyncio.CancelledError:
+                pass
+            self._poll_task = None
+        if self.session is not None:
+            await self.session.close()
+            self.session = None
+
+    async def _poll_loop(self) -> None:
+        """Background /loadz poll: refreshes reports for replicas the
+        traffic isn't touching and notices recoveries without spending a
+        live request as the probe."""
+        while True:
+            await asyncio.sleep(self.cfg.poll_interval)
+            for rep in list(self.balancer.replicas.values()):
+                await self.poll_replica(rep)
+
+    async def poll_replica(self, rep: Replica) -> bool:
+        """One /loadz probe; True = replica answered ready."""
+        try:
+            timeout = aiohttp.ClientTimeout(
+                total=self.cfg.connect_timeout + 1.0,
+                sock_connect=self.cfg.connect_timeout,
+            )
+            async with self.session.get(
+                rep.url + "/loadz", timeout=timeout
+            ) as resp:
+                if resp.status != 200:
+                    return False  # draining/not-ready: steer, don't eject
+                snap = await resp.json()
+        except _TRANSPORT_ERRORS:
+            # The poller observes, it does not punish: ejection windows
+            # grow only from real traffic failures, so a dead replica's
+            # backoff isn't inflated 2x/poll while it restarts.
+            return False
+        except (json.JSONDecodeError, aiohttp.ContentTypeError):
+            return False
+        self.balancer.observe_report(rep, LoadReport.from_snapshot(snap))
+        self.balancer.observe_success(rep)
+        return True
+
+    # -- per-response bookkeeping -----------------------------------------
+
+    def _learn(self, rep: Replica, headers) -> None:
+        raw = headers.get(LOAD_HEADER)
+        if raw:
+            self.balancer.observe_report(rep, LoadReport.from_header(raw))
+
+    def _fail(self, rep: Replica) -> None:
+        window = self.balancer.observe_failure(rep)
+        METRICS.inc(
+            "substratus_gateway_ejections_total", {"replica": rep.url}
+        )
+        log.warning(
+            "replica %s ejected for %.1fs (%d consecutive failures)",
+            rep.url, window, rep.circuit.consecutive_failures,
+        )
+
+    def _set_inflight(self, rep: Replica) -> None:
+        METRICS.set(
+            "substratus_gateway_inflight", rep.inflight,
+            {"replica": rep.url},
+        )
+
+    def _shed(self, reason: str, retry_after: float,
+              status: int = 503) -> web.Response:
+        METRICS.inc("substratus_gateway_sheds_total", {"reason": reason})
+        cls = {429: web.HTTPTooManyRequests,
+               503: web.HTTPServiceUnavailable,
+               504: web.HTTPGatewayTimeout}[status]
+        headers = {}
+        if status in (429, 503):
+            headers["Retry-After"] = str(max(1, int(retry_after + 0.999)))
+        return cls(
+            text=json.dumps({"error": {
+                "message": f"request shed: {reason}", "type": reason,
+            }}),
+            content_type="application/json", headers=headers,
+        )
+
+
+def build_gateway_app(gw: Gateway) -> web.Application:
+    routes = web.RouteTableDef()
+
+    @routes.get("/")
+    async def root(request: web.Request) -> web.Response:
+        # Ready iff at least one replica is routable right now.
+        ok = bool(gw.balancer.eligible())
+        return web.Response(status=200 if ok else 503,
+                            text="ok" if ok else "no eligible replica")
+
+    @routes.get("/loadz")
+    async def loadz(request: web.Request) -> web.Response:
+        now = time.monotonic()
+        return web.json_response({
+            "role": "gateway",
+            "replicas": gw.balancer.snapshot(now),
+            "eligible": len(gw.balancer.eligible(now)),
+        })
+
+    @routes.get("/metrics")
+    async def metrics(request: web.Request) -> web.Response:
+        for rep in gw.balancer.replicas.values():
+            gw._set_inflight(rep)
+        return web.Response(
+            body=METRICS.render().encode(),
+            headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
+            },
+        )
+
+    @routes.get("/v1/models")
+    async def models(request: web.Request) -> web.Response:
+        return await _route(request, b"", streaming=False)
+
+    @routes.post("/v1/completions")
+    @routes.post("/v1/chat/completions")
+    async def completions(request: web.Request) -> web.StreamResponse:
+        body = await request.read()
+        streaming = False
+        try:
+            streaming = bool(json.loads(body or b"{}").get("stream"))
+        except (json.JSONDecodeError, AttributeError):
+            pass  # replicas reject malformed JSON with a 400; just relay
+        # Admission: rate limit, then deadline — an over-budget client
+        # is told to slow down even when its deadline is generous.
+        ok, retry_after = gw.limiter.allow(api_key_of(request.headers))
+        if not ok:
+            raise gw._shed("ratelimit", retry_after, status=429)
+        return await _route(request, body, streaming=streaming)
+
+    async def _route(request: web.Request, body: bytes,
+                     streaming: bool) -> web.StreamResponse:
+        deadline = parse_deadline(
+            request.headers, gw.cfg.default_timeout
+        )
+        remaining = deadline_remaining(deadline)
+        if remaining is not None and remaining <= 0:
+            raise gw._shed("deadline", 0.0, status=504)
+
+        remote = parse_traceparent(request.headers.get("traceparent"))
+        with tracer.span(
+            "gateway.route", parent=remote,
+            method=request.method, path=request.path,
+            stream=streaming,
+        ) as span:
+            resp = await _attempts(request, body, streaming, deadline, span)
+            span.set_attribute("http_status", resp.status)
+            return resp
+
+    async def _attempts(request: web.Request, body: bytes,
+                        streaming: bool, deadline: Optional[float],
+                        span) -> web.StreamResponse:
+        """The hedged-retry loop around single-replica attempts."""
+        tried: tuple = ()
+        # The SSE response toward the client, shared across attempts: a
+        # hedge that fires after upstream #1 produced headers (but no
+        # body bytes) keeps writing into the already-prepared response.
+        stream_state: dict = {"resp": None}
+        shed_response: Optional[web.Response] = None  # replica 429/503
+
+        async def give_up(exc: Optional[web.Response]):
+            """Terminal shed. If an SSE response is already prepared,
+            the only legal ending is in-band: error event + [DONE]."""
+            prepared = stream_state["resp"]
+            if prepared is not None:
+                await _end_stream_with_error(
+                    prepared, None, "no replica left to hedge onto"
+                )
+                return prepared
+            if exc is None:
+                raise gw._shed("no_replica", gw.cfg.backoff_base)
+            return exc
+
+        for attempt in range(1 + gw.cfg.max_hedges):
+            rep = gw.balancer.pick(exclude=tried)
+            if rep is None:
+                if shed_response is not None:
+                    # Every other replica is down/full and this one said
+                    # "not now" — relay its answer, its Retry-After is
+                    # the honest one.
+                    METRICS.inc(
+                        "substratus_gateway_sheds_total",
+                        {"reason": "replica_shed"},
+                    )
+                    return await give_up(shed_response)
+                if stream_state["resp"] is not None:
+                    return await give_up(None)
+                if gw.balancer.saturated():
+                    raise gw._shed("saturated", gw.cfg.shed_retry_after)
+                raise gw._shed("no_replica", gw.cfg.backoff_base)
+            if attempt > 0:
+                METRICS.inc("substratus_gateway_hedges_total")
+                span.set_attribute("hedged", True)
+            span.set_attribute("replica", rep.url)
+            span.set_attribute("attempts", attempt + 1)
+            remaining = deadline_remaining(deadline)
+            if remaining is not None and remaining <= 0:
+                if stream_state["resp"] is not None:
+                    return await give_up(None)
+                raise gw._shed("deadline", 0.0, status=504)
+
+            gw.balancer.acquire(rep)
+            gw._set_inflight(rep)
+            try:
+                result = await _attempt_one(
+                    request, rep, body, streaming, deadline, stream_state
+                )
+            except _ClientGone:
+                # The caller left; the replica served fine. End quietly
+                # (closing the upstream context already aborted the
+                # replica-side handler, which cancels its engine work).
+                log.info("client disconnected mid-relay (%s)", rep.url)
+                return stream_state["resp"]
+            except _TRANSPORT_ERRORS as e:
+                gw._fail(rep)
+                tried = tried + (rep.url,)
+                log.info("attempt on %s failed: %r", rep.url, e)
+                continue  # hedge: nothing reached the client yet
+            finally:
+                gw.balancer.release(rep)
+                gw._set_inflight(rep)
+            if isinstance(result, _ReplicaShed):
+                tried = tried + (rep.url,)
+                shed_response = result.response
+                continue
+            if isinstance(result, _StreamBroken):
+                # Bytes already reached the client: the stream was ended
+                # with an SSE error event inside _attempt_one. No hedge.
+                gw._fail(rep)
+                return result.response
+            return result
+        # Hedge budget exhausted.
+        return await give_up(shed_response)
+
+    async def _attempt_one(request: web.Request, rep: Replica,
+                           body: bytes, streaming: bool,
+                           deadline: Optional[float],
+                           stream_state: dict):
+        """One upstream try. Returns a finished response, _ReplicaShed
+        (replica said 429/503: try elsewhere), or _StreamBroken (died
+        mid-stream, already ended politely). Transport errors raise —
+        but only while nothing has streamed to the client; after that
+        they are converted to _StreamBroken here."""
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() not in (
+                "host", "content-length", "connection", "traceparent",
+            )
+        }
+        headers["traceparent"] = format_traceparent(
+            tracer.current_context()
+        )
+        remaining = deadline_remaining(deadline)
+        if deadline is not None:
+            headers[DEADLINE_HEADER] = f"{deadline:.3f}"
+        timeout = aiohttp.ClientTimeout(
+            total=remaining,  # None = no cap (long SSE decodes)
+            sock_connect=gw.cfg.connect_timeout,
+        )
+        t0 = time.perf_counter()
+        async with gw.session.request(
+            request.method, rep.url + request.path,
+            data=body if request.method == "POST" else None,
+            headers=headers, timeout=timeout,
+        ) as upstream:
+            gw._learn(rep, upstream.headers)
+            if upstream.status in (429, 503):
+                return _ReplicaShed(await _relay_full(upstream))
+            if not streaming or upstream.status != 200:
+                resp = await _relay_full(upstream)
+                # Which replica served it: debugging aid and the hook
+                # chaos tests use to aim their kill.
+                resp.headers["x-substratus-replica"] = rep.url
+                gw.balancer.observe_success(rep)
+                METRICS.observe(
+                    "substratus_gateway_upstream_seconds",
+                    time.perf_counter() - t0,
+                )
+                return resp
+            # SSE relay. The client response is prepared once, on the
+            # first upstream that produced response headers; a hedged
+            # second upstream keeps writing into the same prepared
+            # response (same status/content type by construction).
+            client_resp = stream_state["resp"]
+            if client_resp is None:
+                client_resp = web.StreamResponse(headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "x-substratus-replica": rep.url,
+                })
+                ctx = tracer.current_context()
+                if ctx is not None:
+                    client_resp.headers["x-trace-id"] = ctx.trace_id
+                await client_resp.prepare(request)
+                stream_state["resp"] = client_resp
+            streamed = False
+            try:
+                async for chunk in upstream.content.iter_any():
+                    if chunk:
+                        try:
+                            await client_resp.write(chunk)
+                        except (ConnectionResetError, RuntimeError) as e:
+                            # The CLIENT hung up, not the replica:
+                            # don't let the outer handler blame (and
+                            # eject) a healthy upstream.
+                            raise _ClientGone() from e
+                        streamed = True
+            except _TRANSPORT_ERRORS as e:
+                if not streamed:
+                    raise  # hedgeable: the client saw nothing yet
+                await _end_stream_with_error(client_resp, rep, e)
+                return _StreamBroken(client_resp)
+            gw.balancer.observe_success(rep)
+            METRICS.observe(
+                "substratus_gateway_upstream_seconds",
+                time.perf_counter() - t0,
+            )
+            await client_resp.write_eof()
+            return client_resp
+
+    async def _relay_full(upstream) -> web.Response:
+        payload = await upstream.read()
+        headers = {}
+        for k in ("Content-Type", "Retry-After", "x-trace-id"):
+            if k in upstream.headers:
+                headers[k] = upstream.headers[k]
+        return web.Response(
+            body=payload, status=upstream.status, headers=headers
+        )
+
+    async def _end_stream_with_error(client_resp: web.StreamResponse,
+                                     rep: Replica, err) -> None:
+        """A committed SSE stream whose replica died: end with a
+        well-formed error event + [DONE] so clients terminate cleanly
+        instead of hanging on a half-open socket."""
+        ctx = tracer.current_context()
+        event = {
+            "error": {
+                "message": "replica lost mid-stream; partial output",
+                "type": "upstream_error",
+            },
+            "trace_id": ctx.trace_id if ctx is not None else None,
+        }
+        try:
+            await client_resp.write(
+                f"data: {json.dumps(event)}\n\ndata: [DONE]\n\n".encode()
+            )
+            await client_resp.write_eof()
+        except (ConnectionResetError, RuntimeError):
+            pass  # the client went away too; nothing left to tell it
+        log.warning(
+            "stream on %s broke mid-flight: %r",
+            rep.url if rep is not None else "<none>", err,
+        )
+
+    app = web.Application(middlewares=[counting_middleware])
+    app.add_routes(routes)
+
+    async def _lifecycle(app):
+        await gw.start()
+        yield
+        await gw.close()
+
+    app.cleanup_ctx.append(_lifecycle)
+    return app
+
+
+class _ReplicaShed:
+    """Upstream answered 429/503 (shedding, alive)."""
+
+    def __init__(self, response: web.Response):
+        self.response = response
+
+
+class _StreamBroken:
+    """Upstream died after bytes reached the client; stream already
+    ended with the error event."""
+
+    def __init__(self, response: web.StreamResponse):
+        self.response = response

@@ -1,0 +1,177 @@
+"""In-process test/smoke harness: real engine replicas + gateway on
+loopback sockets, one event loop, no containers.
+
+`InProcessReplica` is a full serving stack — tiny llama Engine (its own
+scheduler thread) + the real aiohttp server from serve/server.py — bound
+to a loopback port. `kill()` closes its listener and aborts live
+connections abruptly (what a crashed pod looks like to the gateway:
+connection reset / refused), and `restart()` rebinds the SAME port with
+a FRESH engine, which is exactly a pod restart. The chaos test
+(tests/test_gateway.py) and `make gateway-smoke`
+(tools/gateway_smoke.py) drive the same harness, so CI and local smoke
+cannot drift.
+
+Imports jax (engine construction) — gateway code itself stays jax-free;
+only this harness pays that cost, and only when instantiated.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from substratus_tpu.gateway.router import (
+    Gateway,
+    GatewayConfig,
+    build_gateway_app,
+)
+
+# Spare id beyond the forced 258-token vocab: random-weight generations
+# never hit it, so greedy decodes run to max_tokens deterministically
+# (the same setup tests/test_multihost_serving.py uses).
+TINY_EOS = 257
+
+
+def build_tiny_engine(max_batch: int = 4, max_seq_len: int = 128,
+                      max_queue: Optional[int] = None):
+    """Random-weight tiny llama engine on CPU, started."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, EngineConfig(
+        max_batch=max_batch, max_seq_len=max_seq_len,
+        eos_token_id=TINY_EOS, max_queue=max_queue,
+    ))
+    engine.start()
+    return engine
+
+
+class InProcessReplica:
+    """One replica: engine + HTTP server on 127.0.0.1:<port>."""
+
+    def __init__(self, name: str = "replica", max_batch: int = 4,
+                 max_seq_len: int = 128,
+                 max_queue: Optional[int] = None):
+        self.name = name
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self.max_queue = max_queue
+        self.port: Optional[int] = None
+        self.engine = None
+        self.state = None
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def start(self, port: int = 0) -> "InProcessReplica":
+        from substratus_tpu.serve.server import ServerState, build_app
+        from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+        loop = asyncio.get_running_loop()
+        # Engine construction compiles nothing but inits params; keep it
+        # off the event loop anyway (fixture parallelism).
+        self.engine = await loop.run_in_executor(
+            None, lambda: build_tiny_engine(
+                self.max_batch, self.max_seq_len, self.max_queue
+            )
+        )
+        self.state = ServerState(self.engine, ByteTokenizer(), self.name)
+        # Near-zero shutdown grace: kill() must look like a crash, not
+        # a drain (the graceful path is tested via server.drain()).
+        self._runner = web.AppRunner(
+            build_app(self.state), shutdown_timeout=0.05
+        )
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def kill(self) -> None:
+        """Abrupt death, in crash order: freeze the engine FIRST so
+        in-flight streams stall mid-decode (tokens stop arriving), then
+        abort the HTTP server — live connections reset without a final
+        chunk, new ones get refused. That is exactly what a killed pod
+        looks like from the gateway: no drain, no goodbye."""
+        eng, self.engine = self.engine, None
+        if eng is not None:
+            eng.stop()  # scheduler exits; no terminal Nones yet
+        if self._runner is not None:
+            await self._runner.cleanup()  # 0.05 s grace, then abort
+            self._runner = None
+        if eng is not None:
+            # Now terminate every stranded request: the aborted
+            # handlers' executor threads are blocked in req.out.get()
+            # and would leak for the life of the test process.
+            for req in (
+                list(eng.slot_req)
+                + list(getattr(eng.queue, "queue", ()))
+                + list(eng._resume)
+            ):
+                if req is not None:
+                    req.finish_reason = "error"
+                    req.out.put(None)
+
+    async def restart(self) -> None:
+        """Pod restart: same address, fresh engine + server."""
+        assert self.port, "start() before restart()"
+        await self.start(port=self.port)
+
+    async def stop(self) -> None:
+        await self.kill()
+
+
+class GatewayHarness:
+    """N in-process replicas behind an in-process gateway."""
+
+    def __init__(self, n_replicas: int = 2,
+                 cfg: Optional[GatewayConfig] = None,
+                 max_batch: int = 4, max_queue: Optional[int] = None):
+        self.replicas = [
+            InProcessReplica(f"replica{i}", max_batch=max_batch,
+                             max_queue=max_queue)
+            for i in range(n_replicas)
+        ]
+        self.cfg = cfg or GatewayConfig(
+            # Fast-twitch settings for tests: short backoff so recovery
+            # is observable in seconds, frequent polling, snappy
+            # connect timeout on loopback.
+            backoff_base=0.2, backoff_cap=2.0, poll_interval=0.2,
+            connect_timeout=1.0,
+        )
+        self.gateway: Optional[Gateway] = None
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def replica_by_url(self, url: str) -> InProcessReplica:
+        return next(r for r in self.replicas if r.url == url.rstrip("/"))
+
+    async def start(self) -> "GatewayHarness":
+        for r in self.replicas:
+            await r.start()
+        self.gateway = Gateway([r.url for r in self.replicas], self.cfg)
+        self._runner = web.AppRunner(build_gateway_app(self.gateway))
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        for r in self.replicas:
+            await r.stop()

@@ -1,3 +1,8 @@
-from substratus_tpu.serve.engine import Engine, EngineConfig, Request
+from substratus_tpu.serve.engine import (
+    Engine,
+    EngineConfig,
+    EngineOverloaded,
+    Request,
+)
 
-__all__ = ["Engine", "EngineConfig", "Request"]
+__all__ = ["Engine", "EngineConfig", "EngineOverloaded", "Request"]

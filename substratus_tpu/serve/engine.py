@@ -84,11 +84,38 @@ METRICS.describe(
 )
 
 
+class EngineOverloaded(RuntimeError):
+    """submit() rejected: the waiting queue is at its configured bound.
+
+    Raised instead of queueing so callers can shed (HTTP 429 +
+    Retry-After) — an unbounded queue converts overload into unbounded
+    tail latency, which every client experiences as an outage anyway.
+    `retry_after` estimates when a slot's worth of work will drain."""
+
+    def __init__(self, queue_depth: int, retry_after: float = 1.0):
+        super().__init__(
+            f"engine overloaded: {queue_depth} requests already waiting"
+        )
+        self.queue_depth = queue_depth
+        self.retry_after = retry_after
+
+
 @dataclass
 class EngineConfig:
     max_batch: int = 8  # decode slots
     max_seq_len: int = 1024  # cache length per slot
     max_prefill_len: int = 512
+    # Waiting-queue bound: submit() raises EngineOverloaded instead of
+    # queueing beyond this many waiters. None = unbounded (legacy
+    # behavior; serve.main defaults it to 4x max_batch).
+    max_queue: Optional[int] = None
+    # Bench/smoke knob: minimum wall time per decode iteration,
+    # simulating accelerator step latency on CPU hosts where the tiny
+    # model's math is instant (the control-plane analogue of
+    # multihost.TcpSync). With it, a CPU gateway bench measures what
+    # the routing tier controls — keeping N replicas concurrently
+    # busy — instead of the host's core count. 0 = off (production).
+    step_floor_s: float = 0.0
     top_k: int = 0  # static top-k (0 = disabled)
     eos_token_id: int = 2
     # "model" keeps the cache in the model dtype; "int8" stores entries
@@ -619,6 +646,13 @@ class Engine:
             req.finish_reason = "error"
             req.out.put(None)  # engine is dead; never strand the caller
             return req
+        if self.ec.max_queue is not None:
+            # Approximate (another submitter may race the read) but the
+            # bound only needs to hold the queue near its limit, not
+            # exactly at it — overload control, not a semaphore.
+            depth = self.queue.qsize()
+            if depth >= self.ec.max_queue:
+                raise EngineOverloaded(depth)
         req.submit_ts = time.perf_counter()
         if req.trace_ctx is None:
             req.trace_ctx = tracer.current_context()
@@ -1269,11 +1303,15 @@ class Engine:
                     self._spec_step()
                 else:
                     self._decode_step()
+                dt_decode = time.perf_counter() - t_decode
                 METRICS.observe(
                     "substratus_serve_phase_seconds",
-                    time.perf_counter() - t_decode,
+                    dt_decode,
                     {"phase": "decode"},
                 )
+                if self.ec.step_floor_s > dt_decode:
+                    # Simulated device-step latency (see EngineConfig).
+                    time.sleep(self.ec.step_floor_s - dt_decode)
         except BaseException as e:  # propagate to waiting callers
             self.error = e
             if self.sync is not None and self.sync.leader:
@@ -1310,6 +1348,25 @@ class Engine:
                 except queue.Empty:
                     break
             raise
+
+    def load_snapshot(self) -> Dict[str, object]:
+        """Cheap load report for the gateway protocol (gateway/
+        loadreport.py): host-side counters only, no device read, no
+        lock — a slightly torn snapshot routes a request marginally
+        suboptimally, which is fine. Served on /loadz and compacted
+        into the x-substratus-load response header."""
+        active = int(self.active.sum())
+        if self.paged:
+            kv_free = self.alloc.free_pages / max(1, self.n_pages)
+        else:
+            kv_free = (self.ec.max_batch - active) / self.ec.max_batch
+        return {
+            "queue_depth": self.queue.qsize() + len(self._resume),
+            "active_slots": active,
+            "max_slots": self.ec.max_batch,
+            "kv_free_frac": round(kv_free, 4),
+            "max_queue": self.ec.max_queue,
+        }
 
     # --- synchronous helper (tests / bench) -------------------------------
 

@@ -138,7 +138,8 @@ def main(argv=None) -> int:
             "model", "config", "quantize", "max_batch", "max_seq_len",
             "max_prefill_len", "kv_cache_dtype", "kv_layout", "attn_impl",
             "chunk_attn_impl", "decode_attn_impl", "q4_impl", "tensor",
-            "sequence", "replicas", "draft_model", "spec_k",
+            "sequence", "replicas", "draft_model", "spec_k", "max_queue",
+            "drain_grace",
         ),
         "serve.main",
     )
@@ -223,6 +224,10 @@ def main(argv=None) -> int:
             decode_attn_impl=params_json.get("decode_attn_impl", "xla"),
         )
 
+    # Bounded admission (gateway contract): beyond this many waiters
+    # submit() sheds with 429 instead of queueing. params.json
+    # {"max_queue": 0} restores the unbounded legacy behavior.
+    max_queue_raw = int(params_json.get("max_queue", 4 * max_batch))
     ec = EngineConfig(
         max_batch=max_batch,
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
@@ -232,6 +237,7 @@ def main(argv=None) -> int:
         eos_token_id=tokenizer.eos_id if tokenizer.eos_id is not None else 2,
         kv_cache_dtype=params_json.get("kv_cache_dtype", "model"),
         kv_layout=kv_layout,
+        max_queue=max_queue_raw if max_queue_raw > 0 else None,
     )
     # Multi-chip serving: tensor-parallel over as many chips as the kv heads
     # allow (params.json {"tensor": N} overrides), data-parallel the rest.
@@ -365,7 +371,11 @@ def main(argv=None) -> int:
         return 0
     state = ServerState(engine, tokenizer, model_name)
     print(f"serving {model_name} on {args.host}:{args.port}", flush=True)
-    serve_forever(state, host=args.host, port=args.port)
+    serve_forever(
+        state, host=args.host, port=args.port,
+        drain_grace_s=float(params_json["drain_grace"])
+        if "drain_grace" in params_json else None,
+    )
     return 0
 
 

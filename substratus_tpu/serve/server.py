@@ -24,11 +24,15 @@ from typing import Optional
 
 from aiohttp import web
 
+from substratus_tpu.gateway.limiter import deadline_remaining, parse_deadline
+from substratus_tpu.gateway.loadreport import HEADER as LOAD_HEADER
+from substratus_tpu.gateway.loadreport import LoadReport
 from substratus_tpu.observability.events import EVENTS
+from substratus_tpu.observability.httpstats import count_http_response
 from substratus_tpu.observability.metrics import METRICS
 from substratus_tpu.observability.propagation import parse_traceparent
 from substratus_tpu.observability.tracing import tracer
-from substratus_tpu.serve.engine import Engine, Request
+from substratus_tpu.serve.engine import Engine, EngineOverloaded, Request
 from substratus_tpu.serve.tokenizer import Tokenizer
 
 # Structured access log: one JSON line per traced request, carrying the
@@ -59,6 +63,10 @@ class ServerState:
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.ready = True
+        # SIGTERM flips this: readiness (`GET /`, `/loadz`) answers 503
+        # so the gateway/Service stop routing here, while in-flight
+        # streams keep running to the drain deadline (serve_forever).
+        self.draining = False
         # The /debug/* plane is gated by the same RBAC check as protected
         # /metrics (observability/authz.py MetricsAuthorizer); None = open
         # (local dev, no kube client to review tokens against).
@@ -177,9 +185,18 @@ async def trace_middleware(request: web.Request, handler):
     responses stamp it before prepare, see _stream), stamped into every
     error payload, and logged as a structured access line. Probe and
     scrape paths (`/`, `/metrics`) stay untraced — a 5 s Prometheus
-    scrape interval would otherwise dominate the span ring."""
+    scrape interval would otherwise dominate the span ring — but every
+    path, traced or not, bumps substratus_http_requests_total (the
+    shed-rate denominator shared with the gateway) and /v1/ responses
+    carry the x-substratus-load report header."""
     if not request.path.startswith(_TRACED_PREFIXES):
-        return await handler(request)
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            count_http_response(request.path, e.status)
+            raise
+        count_http_response(request.path, resp.status)
+        return resp
     remote = parse_traceparent(request.headers.get("traceparent"))
     span = tracer.span(
         "serve.http", parent=remote,
@@ -212,8 +229,17 @@ async def trace_middleware(request: web.Request, handler):
             span.set_attribute("http_status", status)
             if not resp.prepared:
                 resp.headers["x-trace-id"] = span.trace_id
+                state = request.app.get("state")
+                if state is not None and request.path.startswith("/v1/"):
+                    # Passive load reporting: the gateway learns this
+                    # replica's load from the responses it already gets
+                    # (streamed responses stamp it in _stream).
+                    resp.headers[LOAD_HEADER] = LoadReport.from_snapshot(
+                        state.engine.load_snapshot()
+                    ).to_header()
             return resp
     finally:
+        count_http_response(request.path, status)
         access_log.info(
             json.dumps(
                 {
@@ -262,7 +288,26 @@ def build_app(state: ServerState) -> web.Application:
     async def root(request: web.Request) -> web.Response:
         if state.engine.error is not None:
             return web.Response(status=500, text=str(state.engine.error))
+        if state.draining:
+            return web.Response(status=503, text="draining")
         return web.Response(status=200 if state.ready else 503, text="ok")
+
+    @routes.get("/loadz")
+    async def loadz(request: web.Request) -> web.Response:
+        """The load-report endpoint of the gateway protocol (gateway/
+        loadreport.py): engine queue/slot/KV counters plus readiness.
+        Answers 503 while draining — the gateway's poller treats any
+        non-200 as 'stop routing here' without ejecting, which is
+        exactly the graceful-shutdown contract."""
+        snap = state.engine.load_snapshot()
+        snap["model"] = state.model_name
+        snap["draining"] = state.draining
+        if state.engine.error is not None:
+            return web.json_response(
+                {**snap, "error": str(state.engine.error)}, status=500
+            )
+        status = 200 if (state.ready and not state.draining) else 503
+        return web.json_response(snap, status=status)
 
     async def _authorize_debug(request: web.Request) -> None:
         """Gate a /debug/* route with the metrics RBAC check (TokenReview +
@@ -690,6 +735,29 @@ def build_app(state: ServerState) -> web.Application:
                         text="'top_p' must be in (0, 1]"
                     )
 
+    def _check_admission(request: web.Request) -> None:
+        """Per-request admission before any engine work: a draining
+        server stops taking NEW requests (503 so the caller retries on
+        a live replica), and an already-expired deadline is shed as
+        504 — decoding for a client that gave up wastes a slot."""
+        if state.draining:
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps({"error": {
+                    "message": "server is draining", "type": "draining",
+                }}),
+                content_type="application/json",
+                headers={"Retry-After": "1"},
+            )
+        remaining = deadline_remaining(parse_deadline(request.headers))
+        if remaining is not None and remaining <= 0:
+            raise web.HTTPGatewayTimeout(
+                text=json.dumps({"error": {
+                    "message": "request deadline already expired",
+                    "type": "deadline",
+                }}),
+                content_type="application/json",
+            )
+
     def _submit(prompt: str, body: dict, endpoint: str,
                 templated: bool = False) -> Request:
         tok = state.tokenizer
@@ -702,7 +770,21 @@ def build_app(state: ServerState) -> web.Application:
             id=uuid.uuid4().hex,
         )
         state.track_request(req, endpoint)
-        return state.engine.submit(req)
+        try:
+            return state.engine.submit(req)
+        except EngineOverloaded as e:
+            state.untrack_request(req)
+            # Bounded queue -> explicit shed: 429 + Retry-After beats
+            # admitting into a queue whose wait exceeds any deadline.
+            raise web.HTTPTooManyRequests(
+                text=json.dumps({"error": {
+                    "message": str(e), "type": "overloaded",
+                }}),
+                content_type="application/json",
+                headers={
+                    "Retry-After": str(max(1, int(e.retry_after + 0.999)))
+                },
+            )
 
     async def _generate(request: web.Request, prompt: str, body: dict,
                         templated: bool = False):
@@ -745,6 +827,12 @@ def build_app(state: ServerState) -> web.Application:
         headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            # Load report at stream START: by the time it ends the
+            # snapshot would be stale anyway; the gateway treats it as
+            # one more passive sample.
+            LOAD_HEADER: LoadReport.from_snapshot(
+                state.engine.load_snapshot()
+            ).to_header(),
         }
         # SSE headers go out at prepare(), before the middleware sees the
         # response — stamp the trace id here (same id the middleware span
@@ -864,6 +952,7 @@ def build_app(state: ServerState) -> web.Application:
         if prompt is None:
             raise web.HTTPBadRequest(text="missing 'prompt'")
         _validate_body(body)
+        _check_admission(request)
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
         METRICS.inc("substratus_serve_requests_total")
@@ -890,6 +979,7 @@ def build_app(state: ServerState) -> web.Application:
         except json.JSONDecodeError:
             raise web.HTTPBadRequest(text="invalid JSON body")
         _validate_body(body)
+        _check_admission(request)
         messages = body.get("messages") or []
         prompt, templated = state.render_chat(messages)
         METRICS.inc("substratus_serve_requests_total")
@@ -916,12 +1006,61 @@ def build_app(state: ServerState) -> web.Application:
         return web.json_response(resp)
 
     app = web.Application(middlewares=[trace_middleware])
+    app["state"] = state  # middleware reads it for the load header
     app.add_routes(routes)
     return app
 
 
+async def drain(state: ServerState, grace_s: float = 30.0,
+                poll_s: float = 0.1) -> bool:
+    """Graceful-shutdown core, shared by serve_forever and tests:
+    flip readiness off (new requests 503, `/loadz` fails so the
+    gateway stops routing here), then wait for in-flight requests —
+    including active SSE streams — to finish, up to `grace_s`.
+    Returns True when everything drained inside the deadline."""
+    state.draining = True
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + grace_s
+    while state.inflight and loop.time() < deadline:
+        await asyncio.sleep(poll_s)
+    return not state.inflight
+
+
 def serve_forever(
-    state: ServerState, host: str = "0.0.0.0", port: int = 8080
+    state: ServerState, host: str = "0.0.0.0", port: int = 8080,
+    drain_grace_s: Optional[float] = None,
 ) -> None:
+    """Run the app until SIGTERM/SIGINT, then drain gracefully:
+    readiness fails first, in-flight streams finish (up to the grace
+    deadline, SUBSTRATUS_DRAIN_GRACE env or 30 s), THEN the listener
+    closes and the engine stops — kubelet's SIGTERM no longer kills
+    active SSE responses mid-stream (docs/serving.md "Drain")."""
+    if drain_grace_s is None:
+        drain_grace_s = float(os.environ.get("SUBSTRATUS_DRAIN_GRACE", 30))
     app = build_app(state)
-    web.run_app(app, host=host, port=port, print=None)
+
+    async def _run() -> None:
+        runner = web.AppRunner(app, handle_signals=False)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        import signal as _signal
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix loops
+                pass
+        await stop.wait()
+        clean = await drain(state, grace_s=drain_grace_s)
+        logging.getLogger(__name__).info(
+            "drained %s (%d requests still in flight)",
+            "cleanly" if clean else "at deadline", len(state.inflight),
+        )
+        await runner.cleanup()
+
+    asyncio.run(_run())
+    # Engine last: its scheduler must outlive every stream it feeds.
+    state.engine.stop()

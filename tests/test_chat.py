@@ -109,3 +109,78 @@ def test_chat_registered_in_cli():
     )
     assert args.func is not None
     assert args.url == "http://x" and args.max_tokens == 7
+
+def test_stream_chat_honors_retry_after():
+    """A shed (429 + Retry-After) makes the CLI wait and retry, not
+    fail the turn — the client half of the gateway/server load-shedding
+    contract (docs/serving.md "Shedding")."""
+    import http.server
+    import json as _json
+
+    hits = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            hits.append(1)
+            if len(hits) == 1:
+                self.send_response(429)
+                self.send_header("Retry-After", "0")
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            chunk = {"choices": [{"delta": {"content": "hi"}}]}
+            self.wfile.write(
+                f"data: {_json.dumps(chunk)}\n\ndata: [DONE]\n\n".encode()
+            )
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        from substratus_tpu.cli.chat import stream_chat
+
+        out = list(stream_chat(
+            f"http://127.0.0.1:{srv.server_port}",
+            [{"role": "user", "content": "x"}],
+        ))
+        assert out == ["hi"]
+        assert len(hits) == 2  # shed once, retried once
+    finally:
+        srv.shutdown()
+        t.join(timeout=10)
+
+
+def test_stream_chat_gives_up_after_max_retries():
+    import http.server
+    import urllib.error
+
+    class Always429(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(429)
+            self.send_header("Retry-After", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Always429)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        from substratus_tpu.cli.chat import MAX_RETRIES, stream_chat
+
+        with pytest.raises(urllib.error.HTTPError):
+            list(stream_chat(
+                f"http://127.0.0.1:{srv.server_port}",
+                [{"role": "user", "content": "x"}],
+            ))
+    finally:
+        srv.shutdown()
+        t.join(timeout=10)

@@ -1,0 +1,517 @@
+"""Serving gateway tests (ISSUE 5): routing policy, admission control,
+load shedding, the load-report protocol, graceful drain, and the chaos
+path — a replica killed mid-decode must be ejected, its un-streamed
+requests hedged, its committed SSE streams ended with a well-formed
+error event, and the replica recovered after backoff. Everything runs
+on CPU with in-process replicas (gateway/testing.py harness — the same
+one `make gateway-smoke` drives)."""
+import asyncio
+import json
+
+import pytest
+
+from substratus_tpu.gateway.balancer import Balancer
+from substratus_tpu.gateway.health import CircuitBreaker
+from substratus_tpu.gateway.limiter import (
+    KeyedLimiter,
+    TokenBucket,
+    api_key_of,
+    parse_deadline,
+)
+from substratus_tpu.gateway.loadreport import LoadReport
+from substratus_tpu.observability.metrics import METRICS
+
+# ---------------------------------------------------------------------------
+# unit: load-report protocol
+
+
+def test_loadreport_header_roundtrip():
+    rep = LoadReport(queue_depth=3, active_slots=2, max_slots=8,
+                     kv_free_frac=0.75)
+    back = LoadReport.from_header(rep.to_header())
+    assert (back.queue_depth, back.active_slots, back.max_slots) == (3, 2, 8)
+    assert abs(back.kv_free_frac - 0.75) < 1e-9
+
+
+def test_loadreport_tolerates_garbage_header():
+    back = LoadReport.from_header("q=oops whatever a=1 ==")
+    assert back.queue_depth == 0 and back.active_slots == 1
+
+
+def test_loadreport_score_orders_by_pressure():
+    idle = LoadReport(queue_depth=0, active_slots=0, max_slots=8)
+    busy = LoadReport(queue_depth=0, active_slots=8, max_slots=8)
+    queued = LoadReport(queue_depth=4, active_slots=8, max_slots=8)
+    assert idle.score() < busy.score() < queued.score()
+
+
+def test_engine_load_snapshot_parses():
+    """The engine side of the protocol: snapshot -> report, no jax work
+    beyond construction."""
+    from substratus_tpu.gateway.testing import build_tiny_engine
+
+    eng = build_tiny_engine(max_batch=3)
+    try:
+        snap = eng.load_snapshot()
+        rep = LoadReport.from_snapshot(snap)
+        assert rep.max_slots == 3
+        assert rep.queue_depth == 0
+        assert 0.0 <= rep.kv_free_frac <= 1.0
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# unit: circuit breaker / balancer / limiter
+
+
+def test_circuit_exponential_backoff_and_halfopen():
+    cb = CircuitBreaker(backoff_base=1.0, backoff_cap=4.0)
+    assert cb.available(now=0.0)
+    assert cb.record_failure(now=0.0) == 1.0
+    assert not cb.available(now=0.5)
+    assert cb.available(now=1.0) and cb.half_open  # trial request
+    assert cb.record_failure(now=1.0) == 2.0  # doubled
+    assert cb.record_failure(now=3.0) == 4.0
+    assert cb.record_failure(now=7.0) == 4.0  # capped
+    cb.record_success()
+    assert cb.available(now=7.0) and not cb.half_open
+    assert cb.record_failure(now=8.0) == 1.0  # reset to base
+
+
+def test_balancer_prefers_less_loaded():
+    b = Balancer(["http://a", "http://b"], max_inflight=4)
+    ra, rb = b.replicas["http://a"], b.replicas["http://b"]
+    b.observe_report(ra, LoadReport(queue_depth=5, max_slots=8))
+    assert b.pick() is rb
+    # Local in-flight dominates when reports are equal.
+    b.observe_report(ra, LoadReport(max_slots=8))
+    b.acquire(rb)
+    b.acquire(rb)
+    assert b.pick() is ra
+
+
+def test_balancer_inflight_window_and_shed():
+    b = Balancer(["http://a", "http://b"], max_inflight=1)
+    b.acquire(b.replicas["http://a"])
+    b.acquire(b.replicas["http://b"])
+    assert b.pick() is None
+    assert b.saturated()
+    b.release(b.replicas["http://a"])
+    assert b.pick() is b.replicas["http://a"]
+    assert not b.saturated()
+
+
+def test_balancer_exclude_and_ejection():
+    b = Balancer(["http://a", "http://b"])
+    assert b.pick(exclude=("http://a",)) is b.replicas["http://b"]
+    b.observe_failure(b.replicas["http://b"], now=100.0)
+    assert b.pick(now=100.1, exclude=("http://a",)) is None
+    assert not b.saturated(now=100.1)  # down, not full: not "saturated"
+
+
+def test_token_bucket_and_retry_after():
+    tb = TokenBucket(rate=1.0, burst=2.0)
+    assert tb.allow(now=0.0) == (True, 0.0)
+    assert tb.allow(now=0.0)[0] is True
+    ok, retry = tb.allow(now=0.0)
+    assert not ok and 0.9 < retry <= 1.0
+    assert tb.allow(now=1.1)[0] is True  # refilled
+
+
+def test_keyed_limiter_isolates_keys_and_disables():
+    lim = KeyedLimiter(rate=1.0, burst=1.0)
+    assert lim.allow("alice", now=0.0)[0]
+    assert not lim.allow("alice", now=0.0)[0]
+    assert lim.allow("bob", now=0.0)[0]  # alice's burn is not bob's
+    off = KeyedLimiter(rate=0.0)
+    assert all(off.allow("x", now=0.0)[0] for _ in range(100))
+
+
+def test_api_key_and_deadline_parsing():
+    assert api_key_of({"Authorization": "Bearer sk-123"}) == "sk-123"
+    assert api_key_of({"x-api-key": "k2"}) == "k2"
+    assert api_key_of({}) == "anonymous"
+    assert parse_deadline({"x-request-deadline": "123.5"}) == 123.5
+    import time as _time
+
+    t = parse_deadline({"x-request-timeout": "10"})
+    assert t is not None and 8 < t - _time.time() <= 10.5
+    assert parse_deadline({}) is None
+    assert parse_deadline({"x-request-deadline": "junk"}) is None
+
+
+# ---------------------------------------------------------------------------
+# engine + server: bounded queue, drain, deadline shed
+
+
+@pytest.fixture(scope="module")
+def unstarted_engine():
+    """Tiny engine, scheduler NOT running: the queue never drains, so
+    bound behavior is deterministic."""
+    from substratus_tpu.gateway.testing import build_tiny_engine
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    return Engine(cfg, params, EngineConfig(
+        max_batch=2, max_seq_len=64, eos_token_id=257, max_queue=2,
+    ))
+
+
+def test_engine_submit_rejects_over_bound(unstarted_engine):
+    from substratus_tpu.serve.engine import EngineOverloaded, Request
+
+    eng = unstarted_engine
+    reqs = [Request([256, 1], max_tokens=2) for _ in range(2)]
+    for r in reqs:
+        eng.submit(r)
+    with pytest.raises(EngineOverloaded) as exc:
+        eng.submit(Request([256, 2], max_tokens=2))
+    assert exc.value.queue_depth == 2
+    assert exc.value.retry_after > 0
+    # Drain what we queued so later tests see an empty queue.
+    while not eng.queue.empty():
+        eng.queue.get_nowait()
+
+
+def test_server_surfaces_429_and_drain_and_deadline(unstarted_engine):
+    """HTTP contract pieces that need no decoding: a full engine queue
+    is 429 + Retry-After, a draining server answers 503 on readiness,
+    /loadz, and new completions, and an expired deadline is shed 504."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.serve.engine import Request
+    from substratus_tpu.serve.server import ServerState, build_app, drain
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    eng = unstarted_engine
+    state = ServerState(eng, ByteTokenizer(), "tiny")
+
+    async def go():
+        async with TestClient(TestServer(build_app(state))) as client:
+            # /loadz is the gateway protocol's pull side.
+            r = await client.get("/loadz")
+            assert r.status == 200
+            snap = await r.json()
+            assert snap["max_slots"] == 2 and snap["draining"] is False
+
+            # Expired deadline -> 504 before any engine work.
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "x", "max_tokens": 2},
+                headers={"x-request-deadline": "1.0"},
+            )
+            assert r.status == 504
+
+            # Fill the (never-draining) queue -> 429 + Retry-After.
+            held = [eng.submit(Request([256, 1], max_tokens=2))
+                    for _ in range(2)]
+            r = await client.post(
+                "/v1/completions", json={"prompt": "x", "max_tokens": 2}
+            )
+            assert r.status == 429
+            assert int(r.headers["Retry-After"]) >= 1
+            body = await r.json()
+            assert body["error"]["type"] == "overloaded"
+            del held
+            while not eng.queue.empty():
+                eng.queue.get_nowait()
+
+            # requests_total counted the shed (endpoint+code labels).
+            assert METRICS.get(
+                "substratus_http_requests_total",
+                {"endpoint": "/v1/completions", "code": "429"},
+            ) >= 1
+
+            # Drain: readiness flips, in-flight holds it open to the
+            # deadline, new requests are told to go elsewhere.
+            state.inflight["fake"] = {"req": None}
+            ok = await drain(state, grace_s=0.2, poll_s=0.02)
+            assert not ok  # the fake in-flight request outlived grace
+            for path in ("/", "/loadz"):
+                r = await client.get(path)
+                assert r.status == 503, path
+            r = await client.post(
+                "/v1/completions", json={"prompt": "x", "max_tokens": 2}
+            )
+            assert r.status == 503
+            assert (await r.json())["error"]["type"] == "draining"
+            state.inflight.clear()
+            assert await drain(state, grace_s=0.2, poll_s=0.02)
+            state.draining = False
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# gateway HTTP integration (in-process replicas, real sockets)
+
+
+def test_gateway_routing_admission_and_shedding():
+    """One harness, several scenarios: routed completions work and
+    carry trace/replica headers, per-key rate limiting 429s with
+    Retry-After, expired deadlines shed 504, all-replicas-full sheds
+    503, and /metrics exposes the gateway catalog."""
+    import aiohttp
+
+    from substratus_tpu.gateway.router import GatewayConfig
+    from substratus_tpu.gateway.testing import GatewayHarness
+
+    async def go():
+        h = await GatewayHarness(
+            n_replicas=2,
+            cfg=GatewayConfig(
+                # rate far below the test's pacing so the 3rd request
+                # can't sneak back in on refill (first-request compile
+                # time alone would refill a generous bucket).
+                rate=0.1, burst=2.0, backoff_base=0.2, backoff_cap=2.0,
+                poll_interval=0.2, connect_timeout=1.0, max_inflight=8,
+            ),
+        ).start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                # Routed completion: 200, replica named, trace echoed.
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "hello", "max_tokens": 3,
+                          "temperature": 0.0},
+                    headers={"x-api-key": "alice"},
+                ) as r:
+                    assert r.status == 200
+                    assert r.headers["x-substratus-replica"] in (
+                        rep.url for rep in h.replicas
+                    )
+                    body = await r.json()
+                    assert body["usage"]["completion_tokens"] == 3
+
+                # The gateway learned that replica's load passively.
+                served = [
+                    rep for rep in h.gateway.balancer.replicas.values()
+                    if rep.report.max_slots == 4
+                ]
+                assert served, "no load report learned from the header"
+
+                # Per-key rate limit: alice spent 1 of burst 2; the
+                # third immediate request 429s, bob is unaffected.
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "x", "max_tokens": 1},
+                    headers={"x-api-key": "alice"},
+                ) as r:
+                    assert r.status == 200
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "x", "max_tokens": 1},
+                    headers={"x-api-key": "alice"},
+                ) as r:
+                    assert r.status == 429
+                    assert int(r.headers["Retry-After"]) >= 1
+                    assert (await r.json())["error"]["type"] == "ratelimit"
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "x", "max_tokens": 1},
+                    headers={"x-api-key": "bob"},
+                ) as r:
+                    assert r.status == 200
+
+                # Expired deadline: shed 504 at the gateway.
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "x", "max_tokens": 1},
+                    headers={"x-api-key": "carol",
+                             "x-request-deadline": "5.0"},
+                ) as r:
+                    assert r.status == 504
+
+                # A CLIENT hanging up mid-stream is routine and must
+                # NOT eject the (healthy) replica it was reading from.
+                ej_before = {
+                    u: rep.circuit.ejections
+                    for u, rep in h.gateway.balancer.replicas.items()
+                }
+                resp = await s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "long", "max_tokens": 80,
+                          "temperature": 0.0, "stream": True},
+                    headers={"x-api-key": "quitter"},
+                )
+                assert resp.status == 200
+                async for _ in resp.content:
+                    break  # one chunk, then hang up
+                resp.close()
+                await asyncio.sleep(0.5)  # let the relay hit the break
+                for u, rep in h.gateway.balancer.replicas.items():
+                    assert rep.circuit.ejections == ej_before[u], u
+                assert len(h.gateway.balancer.eligible()) == 2
+
+                # Saturation: zero-width in-flight windows => every
+                # healthy replica is "full" => 503 + Retry-After.
+                for rep in h.gateway.balancer.replicas.values():
+                    rep.max_inflight = 0
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "x", "max_tokens": 1},
+                    headers={"x-api-key": "dave"},
+                ) as r:
+                    assert r.status == 503
+                    assert "Retry-After" in r.headers
+                    assert (await r.json())["error"]["type"] == "saturated"
+                for rep in h.gateway.balancer.replicas.values():
+                    rep.max_inflight = 8
+
+                # Catalog: shared requests_total + gateway families.
+                async with s.get(h.url + "/metrics") as r:
+                    text = await r.text()
+                assert "substratus_http_requests_total" in text
+                assert "substratus_gateway_sheds_total" in text
+                assert 'reason="ratelimit"' in text
+                assert 'reason="saturated"' in text
+
+                # Gateway /loadz names both replicas.
+                async with s.get(h.url + "/loadz") as r:
+                    snap = await r.json()
+                assert len(snap["replicas"]) == 2
+                assert snap["eligible"] == 2
+        finally:
+            await h.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=300))
+
+
+def test_gateway_chaos_replica_kill_mid_decode():
+    """THE acceptance chaos path: kill one of two replicas mid-decode.
+    The committed SSE stream ends with a well-formed error event (no
+    hang), the replica is ejected, queued/un-streamed requests hedge to
+    the survivor and ALL complete, and after backoff + restart the
+    replica serves traffic again."""
+    import aiohttp
+
+    from substratus_tpu.gateway.testing import GatewayHarness
+
+    async def go():
+        h = await GatewayHarness(n_replicas=2).start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                # Warm both replicas (compile outside the chaos window).
+                async def warm():
+                    async with s.post(
+                        h.url + "/v1/completions",
+                        json={"prompt": "w", "max_tokens": 2,
+                              "temperature": 0.0},
+                    ) as r:
+                        assert r.status == 200
+                await asyncio.gather(warm(), warm(), warm(), warm())
+
+                # -- mid-stream kill -----------------------------------
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "stream me", "max_tokens": 80,
+                          "temperature": 0.0, "stream": True},
+                ) as r:
+                    assert r.status == 200
+                    victim_url = r.headers["x-substratus-replica"]
+                    victim = h.replica_by_url(victim_url)
+                    lines = []
+                    got_first = False
+                    async for raw in r.content:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        lines.append(line[5:].strip())
+                        if not got_first:
+                            got_first = True
+                            await victim.kill()  # mid-decode, mid-stream
+                    # Stream ENDED (no hang) with the error event + DONE.
+                    assert lines[-1] == "[DONE]"
+                    payloads = [json.loads(p) for p in lines[:-1]
+                                if p != "[DONE]"]
+                    assert any("error" in p for p in payloads), lines[-3:]
+                    err = next(p for p in payloads if "error" in p)
+                    assert err["error"]["type"] == "upstream_error"
+
+                # Ejected: the victim is out of the eligible set.
+                rep = h.gateway.balancer.replicas[victim.url]
+                assert rep.circuit.ejections >= 1
+                assert not rep.circuit.available(
+                    __import__("time").monotonic()
+                ) or rep.circuit.half_open
+
+                # -- queued requests survive on the survivor ------------
+                async def one(i):
+                    async with s.post(
+                        h.url + "/v1/completions",
+                        json={"prompt": f"q{i}", "max_tokens": 8,
+                              "temperature": 0.0},
+                    ) as r:
+                        assert r.status == 200
+                        return r.headers["x-substratus-replica"]
+
+                servers = await asyncio.gather(*(one(i) for i in range(4)))
+                assert all(u != victim.url for u in servers)
+
+                # -- recovery after backoff -----------------------------
+                await victim.restart()
+                for _ in range(100):  # poller interval 0.2s, backoff 0.2s
+                    if h.gateway.balancer.replicas[
+                        victim.url
+                    ].circuit.available(
+                        __import__("time").monotonic()
+                    ) and h.gateway.balancer.replicas[
+                        victim.url
+                    ].circuit.consecutive_failures == 0:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("victim never recovered")
+
+                # Traffic returns to the recovered replica.
+                back = set()
+                for i in range(20):
+                    back.add(await one(100 + i))
+                    if victim.url in back:
+                        break
+                assert victim.url in back
+
+                # -- deterministic hedge: kill a CLOSED-circuit replica
+                # and make it the balancer's clear first choice; the
+                # very next request must try it, fail, and replay onto
+                # the survivor ------------------------------------------
+                hedges0 = METRICS.get("substratus_gateway_hedges_total") or 0
+                # Freeze the poller so the injected scores can't be
+                # refreshed out from under the assertion.
+                if h.gateway._poll_task is not None:
+                    h.gateway._poll_task.cancel()
+                    h.gateway._poll_task = None
+                surv = next(
+                    r for r in h.gateway.balancer.replicas.values()
+                    if r.url != victim.url
+                )
+                h.gateway.balancer.observe_report(
+                    surv, LoadReport(queue_depth=2, max_slots=4)
+                )
+                h.gateway.balancer.observe_report(
+                    h.gateway.balancer.replicas[victim.url], LoadReport()
+                )
+                await victim.kill()
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": "hedge me", "max_tokens": 4,
+                          "temperature": 0.0},
+                ) as r:
+                    assert r.status == 200
+                    assert r.headers["x-substratus-replica"] == surv.url
+                hedges1 = METRICS.get("substratus_gateway_hedges_total") or 0
+                assert hedges1 >= hedges0 + 1
+                assert (
+                    h.gateway.balancer.replicas[victim.url]
+                    .circuit.consecutive_failures > 0
+                )
+        finally:
+            await h.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=300))

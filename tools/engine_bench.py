@@ -23,6 +23,7 @@ cost — events serialized, N-byte collective, mirrored scheduler — is
 real on any backend; only the ICI transfer time needs the chip.
 """
 import argparse
+import asyncio
 import json
 import os
 import subprocess
@@ -150,6 +151,7 @@ def make_engine(a, mesh=None, sync=None):
         kv_layout=a.kv_layout,
         spec_k=a.spec_k,
         eos_token_id=257 if a.config == "tiny" else 2,
+        step_floor_s=a.step_floor_ms / 1e3,
     )
     engine = Engine(cfg, params, ec, mesh=mesh, sync=sync)
     engine.start()
@@ -359,6 +361,202 @@ def run_single_same_shape(a, base_args) -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def serve_worker(a) -> int:
+    """One HTTP replica for the gateway leg: the same engine make_engine
+    builds, behind the real serving app on 127.0.0.1:<port>. SIGTERM
+    drains gracefully (serve/server.py) — the parent's terminate() at
+    the end of the leg is the clean path, its kill during chaos is not."""
+    from substratus_tpu.serve.server import ServerState, serve_forever
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    _, engine = make_engine(a)
+    state = ServerState(engine, ByteTokenizer(), a.config)
+    print(f"replica on 127.0.0.1:{a.port}", flush=True)
+    serve_forever(state, host="127.0.0.1", port=a.port, drain_grace_s=5.0)
+    return 0
+
+
+def _await_ready(url: str, timeout_s: float = 180.0) -> None:
+    import urllib.error
+    import urllib.request
+
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        try:
+            with urllib.request.urlopen(url + "/", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except (OSError, urllib.error.URLError):
+            pass
+        time.sleep(0.5)
+    raise SystemExit(f"replica {url} never became ready")
+
+
+async def _drive_http(base_url: str, a, n_requests: int) -> dict:
+    """The HTTP load: a few sequential streaming requests for
+    client-side TTFT, then the full non-streaming batch fired
+    concurrently for aggregate throughput (completion_tokens summed
+    from the usage blocks — the number the server actually produced)."""
+    import string
+
+    import aiohttp
+
+    rng = __import__("random").Random(0)
+    letters = string.ascii_letters + string.digits
+    prompts = [
+        "".join(rng.choice(letters) for _ in range(max(1, a.prompt_len - 1)))
+        for _ in range(n_requests)
+    ]
+
+    async with aiohttp.ClientSession() as session:
+
+        async def warm(p):
+            async with session.post(
+                base_url + "/v1/completions",
+                json={"prompt": p, "max_tokens": 2, "temperature": 0.0},
+            ) as r:
+                await r.read()
+
+        # Warm every replica's executables outside the clock: fire
+        # 2x the replica count so p2c routing touches them all.
+        await asyncio.gather(*(warm(p) for p in prompts[:4]))
+
+        ttfts = []
+        for p in prompts[:3]:
+            t0 = time.perf_counter()
+            async with session.post(
+                base_url + "/v1/completions",
+                json={"prompt": p, "max_tokens": a.max_tokens,
+                      "temperature": 0.0, "stream": True},
+            ) as r:
+                async for line in r.content:
+                    if line.startswith(b"data:") and b"[DONE]" not in line:
+                        ttfts.append(time.perf_counter() - t0)
+                        break
+                async for _ in r.content:
+                    pass  # drain
+
+        async def run_one(p) -> int:
+            async with session.post(
+                base_url + "/v1/completions",
+                json={"prompt": p, "max_tokens": a.max_tokens,
+                      "temperature": 0.0},
+            ) as r:
+                body = await r.json()
+                if r.status != 200:
+                    raise SystemExit(f"load request failed: {r.status} {body}")
+                return int(body["usage"]["completion_tokens"])
+
+        t0 = time.perf_counter()
+        counts = await asyncio.gather(*(run_one(p) for p in prompts))
+        wall = time.perf_counter() - t0
+    return {
+        "gen_tokens": int(sum(counts)),
+        "wall_s": round(wall, 3),
+        "gen_tok_s": round(sum(counts) / wall, 1),
+        "ttft_ms": _percentiles_ms(ttfts),
+    }
+
+
+def run_gateway_leg(a, base_args) -> dict:
+    """Routed-vs-direct comparison (ISSUE 5 acceptance): N replica
+    server subprocesses behind an in-process gateway vs ONE identical
+    replica addressed directly, same total request count. The parent
+    stays jax-free — it routes and measures, the workers compute."""
+    import socket
+
+    from substratus_tpu.gateway.router import Gateway, GatewayConfig
+
+    n_requests = max(a.requests, 2 * a.batch)
+
+    def spawn(n):
+        ports = []
+        for _ in range(n):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+        procs = [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), *base_args,
+                 "--serve-worker", "--port", str(p)],
+                stdout=sys.stderr, stderr=subprocess.STDOUT,
+            )
+            for p in ports
+        ]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        for u in urls:
+            _await_ready(u)
+        return procs, urls
+
+    def reap(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    async def run_routed(urls) -> dict:
+        from aiohttp import web
+
+        from substratus_tpu.gateway.router import build_gateway_app
+
+        gw = Gateway(urls, GatewayConfig(poll_interval=0.5))
+        runner = web.AppRunner(build_gateway_app(gw))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            return await _drive_http(
+                f"http://127.0.0.1:{port}", a, n_requests
+            )
+        finally:
+            await runner.cleanup()
+
+    procs, urls = spawn(a.gateway)
+    try:
+        routed_result = asyncio.run(run_routed(urls))
+    finally:
+        reap(procs)
+
+    procs, urls = spawn(1)
+    try:
+        direct_result = asyncio.run(
+            _drive_http(urls[0], a, n_requests)
+        )
+    finally:
+        reap(procs)
+
+    ttft_routed = routed_result["ttft_ms"].get("p50")
+    ttft_direct = direct_result["ttft_ms"].get("p50")
+    return {
+        "metric": f"{a.config.replace('-', '_')}_gateway_routed_throughput",
+        "value": routed_result["gen_tok_s"],
+        "unit": "gen_tokens/sec",
+        "replicas": a.gateway,
+        "requests": n_requests,
+        "max_tokens": a.max_tokens,
+        "step_floor_ms": a.step_floor_ms,
+        "direct_value": direct_result["gen_tok_s"],
+        "routed_vs_direct": (
+            round(routed_result["gen_tok_s"] / direct_result["gen_tok_s"], 3)
+            if direct_result["gen_tok_s"] else None
+        ),
+        "ttft_p50_ms": ttft_routed,
+        "ttft_p50_ms_direct": ttft_direct,
+        "ttft_delta_ms": (
+            round(ttft_routed - ttft_direct, 3)
+            if ttft_routed is not None and ttft_direct is not None
+            else None
+        ),
+        "wall_s": routed_result["wall_s"],
+        "wall_s_direct": direct_result["wall_s"],
+    }
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="llama2-7b")
@@ -394,6 +592,12 @@ def parse_args(argv=None):
              "mesh shape; prints the combined comparison JSON",
     )
     ap.add_argument(
+        "--gateway", type=int, default=0,
+        help="N replica HTTP servers behind the routing gateway vs one "
+             "direct replica; prints the routed-vs-direct JSON "
+             "(substratus_tpu/gateway, docs/serving.md)",
+    )
+    ap.add_argument(
         "--long-admission", type=int, default=0,
         help="extra leg: one prompt of this many tokens, its admission "
              "broadcast (JSON-encoded prompt) timed separately — use "
@@ -413,6 +617,13 @@ def parse_args(argv=None):
     )
     ap.add_argument("--gang-timeout", type=float, default=1200.0)
     ap.add_argument(
+        "--step-floor-ms", type=float, default=0.0,
+        help="minimum wall time per decode iteration — simulates "
+             "accelerator step latency on CPU hosts so concurrency "
+             "benches measure the control plane, not the core count "
+             "(0 = off; the --gateway smoke defaults it to 15)",
+    )
+    ap.add_argument(
         "--smoke", action="store_true",
         help="CPU-scaled CI smoke: tiny config, small load",
     )
@@ -425,8 +636,10 @@ def parse_args(argv=None):
         "--json-only", action="store_true",
         help="print only the raw result record (internal)",
     )
-    # gang-worker internals
+    # gang-worker / gateway-replica internals
     ap.add_argument("--gang-worker", action="store_true")
+    ap.add_argument("--serve-worker", action="store_true")
+    ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--pid", type=int, default=0)
     ap.add_argument("--nprocs", type=int, default=0)
     ap.add_argument("--coord", default="")
@@ -436,11 +649,23 @@ def parse_args(argv=None):
     if a.smoke:
         a.config = "tiny"
         a.quantize = "none"
-        a.requests = min(a.requests, 6)
         a.prompt_len = min(a.prompt_len, 16)
-        a.max_tokens = min(a.max_tokens, 8)
         a.batch = min(a.batch, 4)
         a.max_seq_len = min(a.max_seq_len, 128)
+        if a.gateway or a.serve_worker:
+            # The gateway smoke shape (ISSUE 5 acceptance): enough
+            # same-length requests to need full waves on every replica
+            # (2 waves routed, 4 direct), decode long enough to
+            # dominate HTTP/prefill overhead, and a simulated device
+            # step so 'can the gateway keep 2 replicas busy at once'
+            # is what the ratio measures on any host.
+            a.requests = min(a.requests, 4 * a.batch)
+            a.max_tokens = min(a.max_tokens, 48)
+            if not a.step_floor_ms:
+                a.step_floor_ms = 15.0
+        else:
+            a.requests = min(a.requests, 6)
+            a.max_tokens = min(a.max_tokens, 8)
     return a
 
 
@@ -456,6 +681,7 @@ def passthrough_args(a) -> list:
         "--devs-per-proc", str(a.devs_per_proc),
         "--long-admission", str(a.long_admission),
         "--transport", a.transport,
+        "--step-floor-ms", str(a.step_floor_ms),
     ]
     if a.repetitive:
         out.append("--repetitive")
@@ -465,11 +691,21 @@ def passthrough_args(a) -> list:
 def main() -> int:
     a = parse_args()
 
+    if a.gateway:
+        # The gateway parent never touches jax — replicas are
+        # subprocesses, the parent only routes and measures.
+        return print(json.dumps(
+            run_gateway_leg(a, passthrough_args(a))
+        )) or 0
+
     # Honor an explicit JAX_PLATFORMS=cpu even under an injected
     # accelerator plugin whose tunnel may hang (utils/jaxenv.py).
     from substratus_tpu.utils.jaxenv import honor_requested_platform
 
     honor_requested_platform()
+
+    if a.serve_worker:
+        return serve_worker(a)
 
     if a.gang_worker:
         return gang_worker(a)

@@ -1,0 +1,132 @@
+"""Gateway chaos smoke (make gateway-smoke, CI tests workflow).
+
+Two in-process CPU replicas behind the real gateway, scripted
+kill/recover — the same harness the pytest chaos test drives
+(substratus_tpu/gateway/testing.py), run standalone so CI exercises
+the full scenario as one scripted scene and prints a JSON verdict:
+
+  1. routed traffic works and spreads load reports;
+  2. kill replica 0 mid-decode: its committed SSE stream ends with a
+     well-formed error event + [DONE] (no hang), the replica is
+     ejected, and a burst of queued requests all complete on the
+     survivor (hedged where needed);
+  3. restart replica 0: after backoff the poller recovers it and
+     traffic reaches it again.
+
+Exit 0 with {"ok": true, ...} on success; nonzero with the failing
+stage otherwise.
+"""
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def scenario() -> dict:
+    import aiohttp
+
+    from substratus_tpu.gateway.testing import GatewayHarness
+    from substratus_tpu.observability.metrics import METRICS
+
+    out = {"ok": False, "stage": "start"}
+    h = await GatewayHarness(n_replicas=2).start()
+    try:
+        async with aiohttp.ClientSession() as s:
+
+            async def one(prompt: str, max_tokens: int = 8) -> str:
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": prompt, "max_tokens": max_tokens,
+                          "temperature": 0.0},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    return r.headers["x-substratus-replica"]
+
+            # Stage 1: routed traffic (also warms both engines).
+            out["stage"] = "route"
+            await asyncio.gather(*(one(f"warm{i}", 2) for i in range(4)))
+
+            # Stage 2: kill replica 0 mid-stream.
+            out["stage"] = "kill"
+            victim = h.replicas[0]
+            async with s.post(
+                h.url + "/v1/completions",
+                json={"prompt": "stream", "max_tokens": 80,
+                      "temperature": 0.0, "stream": True},
+            ) as r:
+                assert r.status == 200
+                victim = h.replica_by_url(
+                    r.headers["x-substratus-replica"]
+                )
+                lines = []
+                async for raw in r.content:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    lines.append(line[5:].strip())
+                    if len(lines) == 1:
+                        await victim.kill()
+            assert lines[-1] == "[DONE]", "stream did not end with [DONE]"
+            assert any(
+                "upstream_error" in p for p in lines
+            ), "no well-formed SSE error event"
+            out["sse_error_event"] = True
+
+            out["stage"] = "eject+burst"
+            servers = await asyncio.gather(
+                *(one(f"burst{i}") for i in range(4))
+            )
+            assert all(u != victim.url for u in servers), servers
+            rep = h.gateway.balancer.replicas[victim.url]
+            assert rep.circuit.ejections >= 1, "victim never ejected"
+            out["ejections"] = rep.circuit.ejections
+
+            # Stage 3: recover.
+            out["stage"] = "recover"
+            await victim.restart()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                c = h.gateway.balancer.replicas[victim.url].circuit
+                if c.available(time.monotonic()) and (
+                    c.consecutive_failures == 0
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("victim never recovered")
+            back = set()
+            for i in range(20):
+                back.add(await one(f"back{i}"))
+                if victim.url in back:
+                    break
+            assert victim.url in back, "no traffic returned to the victim"
+
+            out.update(
+                ok=True, stage="done",
+                hedges=METRICS.get("substratus_gateway_hedges_total") or 0,
+                requests_total_families=sum(
+                    1 for line in METRICS.render().splitlines()
+                    if line.startswith("substratus_http_requests_total{")
+                ),
+            )
+            return out
+    finally:
+        await h.stop()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = asyncio.run(asyncio.wait_for(scenario(), timeout=600))
+    except Exception as e:  # noqa: BLE001 — verdict JSON is the contract
+        print(json.dumps({"ok": False, "error": repr(e)}))
+        raise
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

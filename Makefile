@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench graft image install-manifests
+.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -88,6 +88,15 @@ gateway-smoke:
 gateway-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --gateway 2 \
 	  --max-tokens 32 | $(PY) hack/bench_compare.py --validate -
+
+# Multi-tenant adapter packing capture (ISSUE 6 acceptance): a mixed
+# 4-adapter engine vs a base-only engine on the same shape with the
+# simulated device step — packed aggregate tok/s must stay within 15%
+# of base (tests/test_adapters.py asserts the ratio; this target
+# validates the capture schema).
+adapter-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --adapters 4 \
+	  | $(PY) hack/bench_compare.py --validate -
 
 # Bench JSON schema + >10% regression gate (hack/bench_compare.py):
 # self-tests that a synthetic 20% regression fails and that the repo's

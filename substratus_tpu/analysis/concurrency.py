@@ -29,6 +29,11 @@ from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
 DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     "serve/engine.py",
     "serve/server.py",
+    # The AdapterStore is shared between the engine scheduler thread and
+    # HTTP handlers (locked-attr discipline: every shared write under
+    # self._lock) — any future thread-entry method there inherits the
+    # engine's scrutiny.
+    "serve/adapters.py",
     # The gateway is single-event-loop by contract (balancer.py docs);
     # covering it means any future thread handed a router method gets
     # the same unlocked-write scrutiny as the engine.

@@ -59,6 +59,7 @@ def stream_chat(
     max_tokens: int = 256,
     temperature: float = 0.7,
     timeout: float = 300.0,
+    model: Optional[str] = None,
 ):
     """POST /v1/chat/completions stream=true; yields content deltas.
 
@@ -71,14 +72,18 @@ def stream_chat(
     )
     from substratus_tpu.observability.tracing import tracer
 
-    body = json.dumps(
-        {
-            "messages": messages,
-            "max_tokens": max_tokens,
-            "temperature": temperature,
-            "stream": True,
-        }
-    ).encode()
+    payload = {
+        "messages": messages,
+        "max_tokens": max_tokens,
+        "temperature": temperature,
+        "stream": True,
+    }
+    if model:
+        # The OpenAI `model` field end to end: the gateway routes by it
+        # (adapter affinity) and the server maps it to a LoRA adapter
+        # slot (multi-tenant serving, docs/serving.md).
+        payload["model"] = model
+    body = json.dumps(payload).encode()
     with tracer.span(
         "cli.chat_request", endpoint="/v1/chat/completions",
         messages=len(messages),
@@ -135,6 +140,7 @@ def repl(
     temperature: float = 0.7,
     system: Optional[str] = None,
     color: Optional[bool] = None,
+    model: Optional[str] = None,
 ) -> int:
     """The chat loop. Plain readline REPL (works over any terminal or
     pty; /quit or EOF exits, /reset clears the conversation)."""
@@ -184,7 +190,8 @@ def repl(
         reply = []
         try:
             for delta in stream_chat(
-                url, messages, max_tokens=max_tokens, temperature=temperature
+                url, messages, max_tokens=max_tokens,
+                temperature=temperature, model=model,
             ):
                 reply.append(delta)
                 stdout.write(delta)
@@ -209,6 +216,7 @@ def repl(
 def run_chat(args) -> int:
     # --plain forces uncolored output (the REPL is line-based either way)
     color = False if getattr(args, "plain", False) else None
+    model = getattr(args, "model", None)
     if args.url:
         return repl(
             args.url,
@@ -216,6 +224,7 @@ def run_chat(args) -> int:
             temperature=args.temperature,
             system=args.system,
             color=color,
+            model=model,
         )
     if not args.name:
         raise SystemExit("sub chat: give a Server name or --url")
@@ -264,4 +273,5 @@ def run_chat(args) -> int:
         temperature=args.temperature,
         system=args.system,
         color=color,
+        model=model,
     )

@@ -637,6 +637,12 @@ def register(sub) -> None:
     )
     p.add_argument("name", nargs="?", help="Server CR name (port-forwards)")
     p.add_argument("--url", help="direct endpoint (e.g. http://localhost:8080)")
+    p.add_argument(
+        "--model", "--adapter", dest="model", default=None,
+        help="model (or LoRA adapter id) to chat with — sent as the "
+             "OpenAI `model` field; the gateway routes by it and the "
+             "server selects the adapter (multi-tenant serving)",
+    )
     p.add_argument("--max-tokens", type=int, default=256)
     p.add_argument("--temperature", type=float, default=0.7)
     p.add_argument("--system", help="system prompt")

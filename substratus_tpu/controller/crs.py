@@ -351,11 +351,17 @@ class NotebookReconciler(BaseReconciler):
 
 class ServerReconciler(BaseReconciler):
     """-server Deployment + Service; Serving condition from readyReplicas
-    (reference server_controller.go:50-335)."""
+    (reference server_controller.go:50-335).
+
+    Servers whose `params.baseModel` names a shared base Model collapse
+    onto ONE backing deployment with every tenant's LoRA adapter mounted
+    (multi-tenant adapter serving) — see _reconcile_shared."""
 
     def __call__(self, obj: Obj) -> Result:
         if not self.image_gate(obj):
             return Result()
+        if ((obj.get("spec") or {}).get("params") or {}).get("baseModel"):
+            return self._reconcile_shared(obj)
         reconcile_child(self.client, params_configmap(obj))
         md = obj["metadata"]
         ns = md["namespace"]
@@ -469,6 +475,172 @@ class ServerReconciler(BaseReconciler):
         set_condition(
             obj, C.CONDITION_SERVING, ready,
             C.REASON_DEPLOYMENT_READY if ready else C.REASON_DEPLOYMENT_NOT_READY,
+        )
+        write_status(self.client, obj)
+        return Result()
+
+    def _reconcile_shared(self, obj: Obj) -> Result:
+        """Multi-tenant adapter serving: every Server in this namespace
+        whose `params.baseModel` names the same base Model CR becomes a
+        TENANT of one shared deployment — the base model loaded once,
+        each tenant's adapter artifact mounted under /content/adapters,
+        one engine serving the whole roster (ParvaGPU's packing insight:
+        spatial sharing, not per-kernel speed, dominates inference
+        economics — ROADMAP item 2, docs/serving.md "Multi-tenant
+        adapters"). The tenant's own `spec.model` must point at its
+        adapter Model (a LoRA finetune: train/main.py writes the
+        `{artifacts}/adapter` artifact); its front Service keeps the
+        `{name}-server` address and selects the shared pods, so clients
+        only ever differ in the OpenAI `model` field."""
+        md = obj["metadata"]
+        ns = md["namespace"]
+        params = (obj.get("spec") or {}).get("params") or {}
+        base_name = str(params["baseModel"])
+
+        # Base Model gate (params-ref flavor of resolve_ref).
+        try:
+            base = self.client.get("Model", ns, base_name)
+        except NotFound:
+            set_condition(
+                obj, C.CONDITION_SERVING, False, C.REASON_MODEL_NOT_FOUND,
+                f"base Model {ns}/{base_name} not found",
+            )
+            obj.setdefault("status", {})["ready"] = False
+            write_status(self.client, obj)
+            return Result()
+        if not base.get("status", {}).get("ready"):
+            set_condition(
+                obj, C.CONDITION_SERVING, False, C.REASON_MODEL_NOT_READY,
+                f"base Model {ns}/{base_name} not ready",
+            )
+            obj.setdefault("status", {})["ready"] = False
+            write_status(self.client, obj)
+            return Result()
+
+        # This tenant's adapter Model gate.
+        adapter_model, park = self.resolve_ref(
+            obj, "model", "Model", C.CONDITION_SERVING,
+            C.REASON_MODEL_NOT_FOUND, C.REASON_MODEL_NOT_READY,
+        )
+        if park:
+            return park
+        if adapter_model is None:
+            set_condition(
+                obj, C.CONDITION_SERVING, False, C.REASON_INVALID_SPEC,
+                "params.baseModel requires spec.model to name the "
+                "tenant's adapter Model",
+            )
+            obj.setdefault("status", {})["ready"] = False
+            write_status(self.client, obj)
+            return Result()
+
+        reconcile_service_account(
+            self.client, self.cloud, self.sci, ns, SA_MODEL_SERVER
+        )
+
+        # The full tenant roster, deterministic: every reconcile (from
+        # any tenant) derives the SAME shared deployment, so
+        # reconcile_child converges instead of churning.
+        tenants = sorted(
+            (
+                s for s in self.client.list("Server", ns)
+                if str(
+                    ((s.get("spec") or {}).get("params") or {}).get(
+                        "baseModel", ""
+                    )
+                ) == base_name
+            ),
+            key=lambda s: s["metadata"]["name"],
+        )
+        adapter_urls: Dict[str, str] = {}
+        replicas = 1
+        for t in tenants:
+            replicas = max(
+                replicas,
+                int((t.get("spec") or {}).get("params", {}).get("replicas", 1)),
+            )
+            ref = (t.get("spec") or {}).get("model")
+            if not ref:
+                continue
+            try:
+                m = self.client.get(
+                    "Model", ref.get("namespace") or ns, ref["name"]
+                )
+            except NotFound:
+                continue
+            if m.get("status", {}).get("ready"):
+                # Tenants whose adapter isn't ready yet simply aren't
+                # mounted; their own reconcile parks them NotReady.
+                adapter_urls[t["metadata"]["name"]] = self.artifact_url_of(m)
+        primary = tenants[0]
+
+        from substratus_tpu.controller.workloads import (
+            shared_server_deployment,
+            shared_server_name,
+            shared_server_selector,
+        )
+
+        # The primary tenant's params ConfigMap configures the engine
+        # (created here too: convergence must not depend on reconcile
+        # order between tenants).
+        reconcile_child(self.client, params_configmap(primary))
+        container = build_container(
+            primary, self.cloud, artifact_mounts={},
+            default_command=SERVER_COMMAND,
+            ports=[{"containerPort": 8080, "name": "http-serve"}],
+        )
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/", "port": 8080},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10,
+        }
+        pod = build_pod(
+            primary, self.cloud,
+            name=shared_server_name(base_name),
+            sa_name=SA_MODEL_SERVER,
+            container=container,
+            mounts={},
+            restart_policy="Always",
+        )
+        if pod["_slice"]["num_hosts"] > 1:
+            obj.setdefault("status", {})["ready"] = False
+            set_condition(
+                obj, C.CONDITION_SERVING, False, C.REASON_INVALID_SPEC,
+                "params.baseModel is unsupported for multi-host slices",
+            )
+            write_status(self.client, obj)
+            return Result()
+        deployment = shared_server_deployment(
+            tenants, self.artifact_url_of(base), adapter_urls, pod,
+            self.cloud, replicas, base_name,
+        )
+        live = reconcile_child(self.client, deployment)
+
+        # Each tenant keeps its own front Service NAME (clients never
+        # re-address when a Server joins or leaves the shared base).
+        service: Obj = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"{md['name']}-server",
+                "namespace": ns,
+                "ownerReferences": [owner_reference(obj)],
+            },
+            "spec": {
+                "selector": shared_server_selector(base_name),
+                "ports": [
+                    {"port": 8080, "targetPort": "http-serve", "name": "http"}
+                ],
+            },
+        }
+        reconcile_child(self.client, service)
+
+        ready = (live.get("status", {}).get("readyReplicas") or 0) > 0
+        obj.setdefault("status", {})["ready"] = ready
+        set_condition(
+            obj, C.CONDITION_SERVING, ready,
+            C.REASON_DEPLOYMENT_READY if ready
+            else C.REASON_DEPLOYMENT_NOT_READY,
         )
         write_status(self.client, obj)
         return Result()

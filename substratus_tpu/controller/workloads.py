@@ -421,6 +421,80 @@ def serving_gateway_workloads(
     return [replicas_svc, deployment]
 
 
+def shared_server_name(base_model_name: str) -> str:
+    """Backing Deployment name for Servers that share one base Model
+    (multi-tenant adapter serving, docs/serving.md)."""
+    return f"{base_model_name}-shared-server"
+
+
+def shared_server_selector(base_model_name: str) -> Dict[str, str]:
+    return {"substratus.ai/object": f"shared-server-{base_model_name}"}
+
+
+ADAPTERS_MOUNT_DIR = "/content/adapters"
+
+
+def shared_server_deployment(
+    tenants: List[Obj],  # every tenant Server, sorted by name
+    base_url: str,
+    adapter_urls: Dict[str, str],  # tenant Server name -> adapter Model url
+    pod: Dict[str, Any],
+    cloud: Cloud,
+    replicas: int,
+    base_model_name: str,
+) -> Obj:
+    """ONE Deployment backing every tenant Server of a base Model: the
+    base mounted at /content/model, each tenant's adapter artifact
+    (`{artifacts}/adapter`, written by train/main.py for LoRA runs)
+    mounted at /content/adapters/<tenant> — serve.main discovers the
+    directory and serves all tenants from one engine (serve/adapters.py).
+
+    Derived entirely from the SORTED tenant list, so whichever tenant's
+    reconcile runs produces the identical object and reconcile_child
+    converges instead of churning. EVERY tenant is an ownerReference
+    (the primary — first by name — as controller): deployment status
+    changes requeue all tenants, and GC only collects the deployment
+    when the last tenant is deleted."""
+    primary = tenants[0]
+    md = primary["metadata"]
+    container = pod["spec"]["containers"][0]
+    cloud.mount_bucket(
+        pod["metadata"], pod["spec"], container, "model", base_url,
+        {"artifacts": "/content/model"}, read_only=True,
+    )
+    for tenant, url in sorted(adapter_urls.items()):
+        cloud.mount_bucket(
+            pod["metadata"], pod["spec"], container, f"adapter-{tenant}",
+            url, {"artifacts/adapter": f"{ADAPTERS_MOUNT_DIR}/{tenant}"},
+            read_only=True,
+        )
+    labels = shared_server_selector(base_model_name)
+    pod["metadata"]["labels"].update(labels)
+    owners = []
+    for t in tenants:
+        ref = owner_reference(t)
+        if t is not primary:
+            ref["controller"] = False
+        owners.append(ref)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": shared_server_name(base_model_name),
+            "namespace": md["namespace"],
+            "ownerReferences": owners,
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": pod["metadata"],
+                "spec": pod["spec"],
+            },
+        },
+    }
+
+
 def serving_gang_name(front_name: str) -> str:
     """JobSet/headless-Service name for a multi-host serving gang whose
     client-facing front Service is `front_name`."""

@@ -109,12 +109,27 @@ class Balancer:
         ]
 
     def pick(self, now: Optional[float] = None,
-             exclude: tuple = ()) -> Optional[Replica]:
+             exclude: tuple = (), adapter: Optional[str] = None) -> Optional[Replica]:
         """Power-of-two-choices among eligible replicas; None = shed.
-        `exclude` carries the urls a hedged retry already failed on."""
+        `exclude` carries the urls a hedged retry already failed on.
+
+        `adapter` is the request's LoRA adapter id (the OpenAI `model`
+        field): replicas whose last load report lists it resident are
+        preferred — same-tenant traffic concentrates where the weights
+        (and that tenant's prefix-cache pages) already live, instead of
+        making every replica hot-load every adapter. p2c still runs
+        WITHIN the resident subset, so affinity never defeats load
+        balancing; with no resident replica it falls back to the full
+        candidate set (the chosen replica hot-loads on admission)."""
         cands = self.eligible(now, exclude)
         if not cands:
             return None
+        if adapter:
+            resident = [
+                r for r in cands if adapter in r.report.adapters
+            ]
+            if resident:
+                cands = resident
         if len(cands) <= 2:
             return min(cands, key=lambda r: r.score())
         a, b = self._rng.sample(cands, 2)

@@ -21,8 +21,14 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from typing import Tuple
 
 HEADER = "x-substratus-load"
+
+# Resident-adapter ids on the header are capped: affinity only needs
+# "is my adapter here", and an unbounded tenant list would bloat every
+# response by the whole roster.
+MAX_HEADER_ADAPTERS = 8
 
 
 @dataclass
@@ -33,6 +39,10 @@ class LoadReport:
     active_slots: int = 0  # slots currently generating
     max_slots: int = 1  # configured decode slot ceiling (max_batch)
     kv_free_frac: float = 1.0  # free fraction of the KV pool [0, 1]
+    # Resident LoRA adapter ids (serve/adapters.py) — the gateway's
+    # adapter-affinity scoring prefers replicas that already hold a
+    # request's adapter (balancer.py).
+    adapters: Tuple[str, ...] = ()
     # Stamped by the RECEIVER (gateway clock): reports age out rather
     # than mislead — a 30 s old "idle" beats routing storms.
     ts: float = field(default_factory=time.monotonic)
@@ -47,10 +57,21 @@ class LoadReport:
         return 2.0 * self.queue_depth + occupancy + 0.5 * kv_pressure
 
     def to_header(self) -> str:
-        return (
+        out = (
             f"q={self.queue_depth} a={self.active_slots} "
             f"m={self.max_slots} kvf={self.kv_free_frac:.3f}"
         )
+        if self.adapters:
+            # `;`-joined: header values stay comma/space-free so the
+            # k=v split survives; ids with either separator are dropped
+            # rather than corrupting the whole report.
+            ids = [
+                a for a in self.adapters[:MAX_HEADER_ADAPTERS]
+                if a and not set(a) & {" ", ",", ";", "="}
+            ]
+            if ids:
+                out += f" ad={';'.join(ids)}"
+        return out
 
     @classmethod
     def from_header(cls, value: str) -> "LoadReport":
@@ -58,10 +79,14 @@ class LoadReport:
         fall back to the defaults (a half-parsed report still beats no
         report)."""
         kv = {}
+        adapters: Tuple[str, ...] = ()
         for part in value.replace(",", " ").split():
             if "=" not in part:
                 continue
             k, _, v = part.partition("=")
+            if k == "ad":
+                adapters = tuple(a for a in v.split(";") if a)
+                continue
             try:
                 kv[k] = float(v)
             except ValueError:
@@ -71,6 +96,7 @@ class LoadReport:
             active_slots=int(kv.get("a", 0)),
             max_slots=max(1, int(kv.get("m", 1))),
             kv_free_frac=min(1.0, max(0.0, kv.get("kvf", 1.0))),
+            adapters=adapters,
         )
 
     @classmethod
@@ -82,5 +108,8 @@ class LoadReport:
             max_slots=max(1, int(snap.get("max_slots", 1))),
             kv_free_frac=min(
                 1.0, max(0.0, float(snap.get("kv_free_frac", 1.0)))
+            ),
+            adapters=tuple(
+                str(a) for a in (snap.get("adapters") or ())
             ),
         )

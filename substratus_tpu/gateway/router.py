@@ -294,8 +294,16 @@ def build_gateway_app(gw: Gateway) -> web.Application:
     async def completions(request: web.Request) -> web.StreamResponse:
         body = await request.read()
         streaming = False
+        adapter = None
         try:
-            streaming = bool(json.loads(body or b"{}").get("stream"))
+            parsed = json.loads(body or b"{}")
+            streaming = bool(parsed.get("stream"))
+            # The OpenAI `model` field doubles as the routing affinity
+            # key: replicas report resident adapter ids on
+            # x-substratus-load, and the balancer prefers them. A base-
+            # model name no replica reports simply never matches.
+            model = parsed.get("model")
+            adapter = str(model) if model else None
         except (json.JSONDecodeError, AttributeError):
             pass  # replicas reject malformed JSON with a 400; just relay
         # Admission: rate limit, then deadline — an over-budget client
@@ -303,10 +311,12 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         ok, retry_after = gw.limiter.allow(api_key_of(request.headers))
         if not ok:
             raise gw._shed("ratelimit", retry_after, status=429)
-        return await _route(request, body, streaming=streaming)
+        return await _route(request, body, streaming=streaming,
+                            adapter=adapter)
 
     async def _route(request: web.Request, body: bytes,
-                     streaming: bool) -> web.StreamResponse:
+                     streaming: bool,
+                     adapter: Optional[str] = None) -> web.StreamResponse:
         deadline = parse_deadline(
             request.headers, gw.cfg.default_timeout
         )
@@ -320,13 +330,18 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             method=request.method, path=request.path,
             stream=streaming,
         ) as span:
-            resp = await _attempts(request, body, streaming, deadline, span)
+            if adapter:
+                span.set_attribute("adapter", adapter)
+            resp = await _attempts(
+                request, body, streaming, deadline, span, adapter
+            )
             span.set_attribute("http_status", resp.status)
             return resp
 
     async def _attempts(request: web.Request, body: bytes,
                         streaming: bool, deadline: Optional[float],
-                        span) -> web.StreamResponse:
+                        span, adapter: Optional[str] = None
+                        ) -> web.StreamResponse:
         """The hedged-retry loop around single-replica attempts."""
         tried: tuple = ()
         # The SSE response toward the client, shared across attempts: a
@@ -349,7 +364,7 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             return exc
 
         for attempt in range(1 + gw.cfg.max_hedges):
-            rep = gw.balancer.pick(exclude=tried)
+            rep = gw.balancer.pick(exclude=tried, adapter=adapter)
             if rep is None:
                 if shed_response is not None:
                     # Every other replica is down/full and this one said

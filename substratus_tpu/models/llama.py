@@ -27,7 +27,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from substratus_tpu.ops.attention import dot_product_attention
-from substratus_tpu.ops.basics import rms_norm, rope, swiglu, lora_delta
+from substratus_tpu.ops.basics import (
+    lora_delta,
+    lora_delta_indexed,
+    rms_norm,
+    rope,
+    swiglu,
+)
 from substratus_tpu.ops.quant import materialize, qeinsum, qeinsum_w8a8
 from substratus_tpu.utils import jaxcompat
 
@@ -37,6 +43,9 @@ Params = Dict[str, Any]
 SUPPORTS_INT8_KV = True
 # train/lora.py adapters are implemented for this family's projections.
 SUPPORTS_LORA = True
+# forward() accepts slot-stacked adapter trees + a per-row adapter_ids
+# gather — multi-tenant adapter serving (serve/adapters.py).
+SUPPORTS_INDEXED_LORA = True
 
 
 @dataclass(frozen=True)
@@ -443,12 +452,17 @@ def _block(
     lora_scale: float = 1.0,
     train: bool = False,
     block_table: Optional[jnp.ndarray] = None,  # [B, M]: paged cache layout
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B]: slot-stacked adapters
 ) -> Tuple[jnp.ndarray, Params, jnp.ndarray]:
     """One transformer block. Returns (x_out, kv_out, aux): kv_out is a dict
     of either the freshly computed seq entries {k, v} (no cache: training /
     prefill) or the updated full cache rows (decode — including k_scale/
     v_scale when the cache is int8-quantized); aux is the MoE
-    load-balancing loss (0 for dense layers)."""
+    load-balancing loss (0 for dense layers).
+
+    With adapter_ids, the lora leaves carry a leading adapter-slot axis
+    (serve/adapters.py stacks N tenants' adapters) and every row gathers
+    its own pair — one dispatch serves a mixed-tenant batch."""
     dt = cfg.dtype
     lora = lora_layers or {}
 
@@ -457,7 +471,12 @@ def _block(
     def proj(name: str, inp: jnp.ndarray, eq: str, lora_eq: str) -> jnp.ndarray:
         out = qe(eq, inp, lp[name], dt)
         if name in lora:
-            out = out + lora_delta(inp, lora[name], lora_scale, lora_eq)
+            if adapter_ids is not None:
+                out = out + lora_delta_indexed(
+                    inp, lora[name], lora_scale, lora_eq, adapter_ids
+                )
+            else:
+                out = out + lora_delta(inp, lora[name], lora_scale, lora_eq)
         return out
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -493,7 +512,14 @@ def _block(
     attn_flat = attn.reshape(b, s, -1)
     o = qeinsum("bshk,hkd->bsd", attn, lp["wo"], dt)
     if "wo" in lora:
-        o = o + lora_delta(attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd")
+        if adapter_ids is not None:
+            o = o + lora_delta_indexed(
+                attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd", adapter_ids
+            )
+        else:
+            o = o + lora_delta(
+                attn_flat, lora["wo"], lora_scale, "bsr,rd->bsd"
+            )
     x = x + o
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
@@ -520,6 +546,9 @@ def forward(
     kv_length: Optional[jnp.ndarray] = None,  # [B] valid cache prefix; use
     # when slots <= position may hold stale data (e.g. resumed caches)
     lora: Optional[Params] = None,  # adapter tree from train.lora.init_lora
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32 — lora leaves
+    # carry a leading adapter-slot axis and each row gathers its own pair
+    # (multi-tenant serving; serve/adapters.py::AdapterStore.device_tree)
     remat: bool = False,  # rematerialize each block (training memory saver)
     train: bool = False,  # MoE: capacity dispatch (train) vs exact (infer)
 ) -> Tuple[jnp.ndarray, Params]:
@@ -551,6 +580,7 @@ def forward(
             lora_scale,
             train,
             block_table,
+            adapter_ids,
         )
         return x_out, {"kv": kv, "aux": aux}
 

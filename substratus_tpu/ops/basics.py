@@ -70,3 +70,25 @@ def lora_delta(h, adapter, scale, out_einsum: str):
     (adapter trees come from train/lora.py)."""
     down = jnp.einsum("bsd,dr->bsr", h, adapter["a"])
     return jnp.einsum(out_einsum, down, adapter["b"]) * scale
+
+
+def batched_lora_einsum(out_einsum: str) -> str:
+    """The per-row form of a lora_delta output einsum: the second
+    operand (the gathered B matrices) grows a leading batch axis, e.g.
+    'bsr,rhk->bshk' -> 'bsr,brhk->bshk'."""
+    lhs, _, out = out_einsum.partition("->")
+    first, _, second = lhs.partition(",")
+    return f"{first},b{second}->{out}"
+
+
+def lora_delta_indexed(h, adapter, scale, out_einsum: str, adapter_ids):
+    """Per-batch-row LoRA update for multi-tenant serving
+    (serve/adapters.py): the adapter leaves carry a leading adapter-slot
+    axis (`a: [A, in, r]`, `b: [A, r, ...out]`) and `adapter_ids` [B]
+    gathers each row's pair, so one einsum applies every tenant's delta
+    in the same dispatch. Slot 0 is the all-zero identity adapter —
+    rows without a tenant gather zeros and stay exactly the base model."""
+    a = jnp.take(adapter["a"], adapter_ids, axis=0)  # [B, in, r]
+    b = jnp.take(adapter["b"], adapter_ids, axis=0)  # [B, r, ...out]
+    down = jnp.einsum("bsd,bdr->bsr", h, a)
+    return jnp.einsum(batched_lora_einsum(out_einsum), down, b) * scale

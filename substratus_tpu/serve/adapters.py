@@ -1,0 +1,501 @@
+"""Multi-tenant LoRA adapter serving: one base model, many adapters.
+
+`train/` produces LoRA adapter trees (train/lora.py) but serving a
+finetune used to mean a whole dedicated engine — one compiled program,
+one KV pool, one replica set per tenant. This module packs N tenants
+into ONE engine: the `AdapterStore` hot-loads adapter artifacts into
+stacked per-layer tensors (`a: [L, A, in, r]`, `b: [L, A, r, ...out]`,
+adapter slot 0 = the all-zero "identity" adapter so baseless requests
+pay nothing), and the engine gathers each batch row's adapter by index
+inside the jitted prefill/decode functions (`jnp.take` along the
+adapter axis feeding the lora_delta einsums, ops/basics.py::
+lora_delta_indexed). Shapes are static — capacity, rank and targets are
+fixed at store construction — so loading or evicting an adapter never
+recompiles anything, and a mixed-adapter batch runs in the one decode
+executable the engine already has.
+
+Threading contract: the store is shared between the engine scheduler
+thread (reads the device tree, pins/unpins slots at admission/release)
+and HTTP handlers (`known()` checks, snapshots, explicit loads). All
+shared state is mutated under `self._lock`; the device tree is rebuilt
+lazily by whoever reads it after a mutation, also under the lock, so a
+half-written adapter slot is never uploaded.
+
+Artifact layout (docs/container-contract.md "Adapter artifacts"):
+
+    <dir>/substratus.json   {"format": "substratus-tpu-adapter-v1",
+                             "lora": {"rank", "alpha", "targets"}, ...}
+    <dir>/adapters.npz      {name}.a / {name}.b per target projection
+
+The container contract mounts adapter artifacts under
+`/content/adapters/<id>/`; the store's `search_dir` makes every subdir
+there loadable on demand — the cache-miss path IS the hot-load path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.train.lora import DEFAULT_TARGETS
+
+ADAPTER_META_FILE = "substratus.json"
+ADAPTER_FORMAT = "substratus-tpu-adapter-v1"
+ADAPTER_WEIGHTS_FILE = "adapters.npz"
+
+# Adapter-serving metric catalog (docs/observability.md). Declared at
+# import so /metrics carries HELP/TYPE before the first load.
+METRICS.describe(
+    "substratus_serve_adapters_loaded",
+    "LoRA adapters currently resident in the engine's adapter slots "
+    "(identity slot 0 excluded).",
+    type="gauge",
+)
+METRICS.describe(
+    "substratus_serve_adapter_evictions_total",
+    "Adapters evicted from their slot to make room for another load.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_serve_adapter_cache_hits_total",
+    "Requests whose adapter was already resident at admission.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_serve_adapter_cache_misses_total",
+    "Requests whose adapter had to be hot-loaded from its artifact at "
+    "admission.",
+    type="counter",
+)
+
+
+class UnknownAdapter(KeyError):
+    """The adapter id is neither loaded nor loadable from any known
+    artifact path — the HTTP layer turns this into a 404."""
+
+    def __init__(self, adapter_id: str):
+        super().__init__(adapter_id)
+        self.adapter_id = adapter_id
+
+    def __str__(self) -> str:
+        return f"unknown adapter {self.adapter_id!r}"
+
+
+class AdapterCapacityError(RuntimeError):
+    """Every adapter slot is pinned by an active request; transient —
+    the scheduler holds the request until a decode slot frees one."""
+
+
+def _target_shapes(cfg, targets: Sequence[str]) -> Dict[str, Tuple]:
+    """(in_dim, out_shape) per target projection — the same layout map
+    train/lora.py::init_lora uses, minus the expert-routed MoE leaves
+    (per-row gather over an [L, A, E, ...] tree is not implemented; the
+    attention and dense-MLP projections are)."""
+    hd = cfg.head_size
+    out_shape = {
+        "wq": (cfg.n_heads, hd),
+        "wk": (cfg.n_kv_heads, hd),
+        "wv": (cfg.n_kv_heads, hd),
+        "wo": (cfg.dim,),
+        "w_gate": (cfg.hidden_dim,),
+        "w_up": (cfg.hidden_dim,),
+        "w_down": (cfg.dim,),
+    }
+    in_dim = {
+        "wq": cfg.dim, "wk": cfg.dim, "wv": cfg.dim,
+        "wo": cfg.n_heads * hd,
+        "w_gate": cfg.dim, "w_up": cfg.dim,
+        "w_down": cfg.hidden_dim,
+    }
+    moe = getattr(cfg, "n_experts", 0) > 0
+    shapes = {}
+    for name in targets:
+        if name not in out_shape:
+            raise ValueError(f"unknown adapter target {name!r}")
+        if moe and name in ("w_gate", "w_up", "w_down"):
+            raise ValueError(
+                f"adapter target {name!r} is expert-routed under MoE "
+                "configs; slot-indexed serving supports the attention "
+                "and dense-MLP projections"
+            )
+        shapes[name] = (in_dim[name], out_shape[name])
+    return shapes
+
+
+def save_adapter_artifact(
+    path: str,
+    adapters: Dict[str, Any],  # {name: {"a": [L, in, r], "b": [L, r, ...]}}
+    alpha: float,
+    rank: int,
+    extra_meta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Write a servable LoRA adapter artifact: npz weights + config
+    sidecar (the adapter-sized sibling of checkpoints.save_artifact)."""
+    os.makedirs(path, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for name, ab in adapters.items():
+        arrays[f"{name}.a"] = np.asarray(ab["a"], np.float32)
+        arrays[f"{name}.b"] = np.asarray(ab["b"], np.float32)
+    np.savez(os.path.join(path, ADAPTER_WEIGHTS_FILE), **arrays)
+    meta = {
+        "format": ADAPTER_FORMAT,
+        "lora": {
+            "rank": int(rank),
+            "alpha": float(alpha),
+            "targets": sorted(adapters),
+        },
+    }
+    meta.update(extra_meta or {})
+    with open(os.path.join(path, ADAPTER_META_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def is_adapter_artifact(path: str) -> bool:
+    meta_path = os.path.join(path, ADAPTER_META_FILE)
+    if not os.path.exists(meta_path):
+        return False
+    try:
+        with open(meta_path) as f:
+            return json.load(f).get("format") == ADAPTER_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+def load_adapter_artifact(path: str) -> Tuple[Dict[str, Any], float, dict]:
+    """Read an adapter artifact dir; returns (layers_tree, scale, meta).
+    scale = alpha / rank, the factor models.llama.forward applies."""
+    with open(os.path.join(path, ADAPTER_META_FILE)) as f:
+        meta = json.load(f)
+    if meta.get("format") != ADAPTER_FORMAT:
+        raise ValueError(
+            f"{path}: not an adapter artifact "
+            f"(format={meta.get('format')!r})"
+        )
+    lora = meta.get("lora") or {}
+    rank = int(lora.get("rank", 0))
+    alpha = float(lora.get("alpha", rank))
+    if rank < 1:
+        raise ValueError(f"{path}: adapter metadata missing a valid rank")
+    with np.load(os.path.join(path, ADAPTER_WEIGHTS_FILE)) as z:
+        layers: Dict[str, Any] = {}
+        for key in z.files:
+            name, _, leaf = key.rpartition(".")
+            if leaf not in ("a", "b") or not name:
+                raise ValueError(f"{path}: unexpected weight key {key!r}")
+            layers.setdefault(name, {})[leaf] = np.asarray(z[key], np.float32)
+    for name, ab in layers.items():
+        if set(ab) != {"a", "b"}:
+            raise ValueError(f"{path}: target {name!r} missing a/b pair")
+    return layers, alpha / rank, meta
+
+
+def infer_store_shape(
+    paths: Sequence[str],
+) -> Tuple[int, Tuple[str, ...]]:
+    """(max rank, union of targets) across adapter artifacts — the store
+    shape that can hold all of them (smaller ranks zero-pad exactly).
+    Falls back to (8, DEFAULT_TARGETS) when nothing is readable."""
+    rank, targets = 0, set()
+    for path in paths:
+        try:
+            with open(os.path.join(path, ADAPTER_META_FILE)) as f:
+                lora = json.load(f).get("lora") or {}
+        except (OSError, ValueError):
+            continue
+        rank = max(rank, int(lora.get("rank", 0)))
+        targets.update(lora.get("targets") or ())
+    if rank < 1 or not targets:
+        return 8, tuple(DEFAULT_TARGETS)
+    return rank, tuple(sorted(targets))
+
+
+class AdapterStore:
+    """Stacked adapter slots for one engine.
+
+    Slot 0 is the identity adapter (all zero): requests without an
+    adapter gather zeros and pay only the (tiny) rank-r einsum, which
+    is the price of keeping ONE decode executable for the whole mixed
+    batch — no per-tenant recompilation, ever.
+
+    `capacity` counts loadable tenant slots (identity slot excluded).
+    The per-target host buffers are float32 with the adapter's
+    alpha/rank scale folded into `b`, so the device tree carries a
+    single scale of 1.0 for every slot regardless of each tenant's
+    training hyperparameters.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        capacity: int = 8,
+        rank: int = 8,
+        targets: Sequence[str] = DEFAULT_TARGETS,
+        dtype=None,
+        search_dir: Optional[str] = None,
+    ):
+        if capacity < 1:
+            raise ValueError(f"adapter capacity {capacity} invalid")
+        if rank < 1:
+            raise ValueError(f"adapter rank {rank} invalid")
+        self.cfg = cfg
+        self.capacity = capacity
+        self.rank = rank
+        self.targets = tuple(targets)
+        self.dtype = dtype if dtype is not None else cfg.dtype
+        self.search_dir = search_dir
+        L = cfg.n_layers
+        A = capacity + 1  # + identity slot 0
+        self.n_slots = A
+        self._shapes = _target_shapes(cfg, self.targets)
+        self._lock = threading.Lock()
+        # Everything below is shared between the engine thread and HTTP
+        # handlers and only ever touched under self._lock.
+        self._a = {
+            name: np.zeros((L, A, ind, rank), np.float32)
+            for name, (ind, _out) in self._shapes.items()
+        }
+        self._b = {
+            name: np.zeros((L, A, rank) + out, np.float32)
+            for name, (_ind, out) in self._shapes.items()
+        }
+        self._slot_id: List[Optional[str]] = [None] * A  # slot -> adapter id
+        self._by_id: Dict[str, int] = {}
+        self._paths: Dict[str, str] = {}  # id -> artifact dir (reloadable)
+        self._refs = [0] * A  # active engine slots pinning this adapter
+        self._last_used = [0.0] * A
+        self._version = 1
+        self._device: Tuple[int, Optional[dict]] = (0, None)
+        self.stats: Dict[str, int] = {"hits": 0, "misses": 0, "evictions": 0}
+
+    # -- registration / lookup (any thread) --------------------------------
+
+    def register_path(self, adapter_id: str, path: str) -> None:
+        """Make an adapter loadable by id without loading it yet."""
+        with self._lock:
+            self._paths[adapter_id] = path
+
+    def scan_search_dir(self) -> List[str]:
+        """Register every artifact subdir of search_dir; returns the ids
+        found (the container-contract /content/adapters layout)."""
+        if not self.search_dir or not os.path.isdir(self.search_dir):
+            return []
+        found = []
+        for entry in sorted(os.listdir(self.search_dir)):
+            path = os.path.join(self.search_dir, entry)
+            if is_adapter_artifact(path):
+                self.register_path(entry, path)
+                found.append(entry)
+        return found
+
+    def _path_of(self, adapter_id: str) -> Optional[str]:
+        # caller holds the lock
+        path = self._paths.get(adapter_id)
+        if path is None and self.search_dir:
+            cand = os.path.join(self.search_dir, adapter_id)
+            if is_adapter_artifact(cand):
+                self._paths[adapter_id] = cand
+                path = cand
+        return path
+
+    def known(self, adapter_id: str) -> bool:
+        """Resident or loadable — the HTTP layer's pre-submit check."""
+        with self._lock:
+            return (
+                adapter_id in self._by_id
+                or self._path_of(adapter_id) is not None
+            )
+
+    def loaded_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_id)
+
+    def available_ids(self) -> List[str]:
+        """Resident + registered + discoverable adapters — what
+        /v1/models advertises as servable."""
+        self.scan_search_dir()
+        with self._lock:
+            return sorted(set(self._by_id) | set(self._paths))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """/loadz block: what's resident plus the hit/miss/evict
+        counters (mirrored from the metrics registry so a scrapeless
+        poll still sees them)."""
+        with self._lock:
+            return {
+                "loaded": sorted(self._by_id),
+                "capacity": self.capacity,
+                "hits": self.stats["hits"],
+                "misses": self.stats["misses"],
+                "evictions": self.stats["evictions"],
+            }
+
+    # -- load / evict -------------------------------------------------------
+
+    def install(
+        self, adapter_id: str, layers: Dict[str, Any], scale: float = 1.0
+    ) -> int:
+        """Install an in-memory adapter tree into a slot (evicting the
+        LRU unpinned resident if full); returns the slot index.
+
+        Accepts rank <= the store rank (zero-padded — exact, the extra
+        rank columns contribute nothing) and any subset of the store's
+        targets (missing targets stay zero)."""
+        if not adapter_id:
+            raise ValueError("adapter id must be non-empty")
+        unknown = set(layers) - set(self._shapes)
+        if unknown:
+            raise ValueError(
+                f"adapter {adapter_id!r} targets {sorted(unknown)} not in "
+                f"the store's target set {sorted(self._shapes)}"
+            )
+        checked: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for name, (ind, out) in self._shapes.items():
+            ab = layers.get(name)
+            if ab is None:
+                continue
+            a = np.asarray(ab["a"], np.float32)
+            b = np.asarray(ab["b"], np.float32)
+            want_a = (self.cfg.n_layers, ind)
+            if a.shape[:2] != want_a or a.shape[2] > self.rank:
+                raise ValueError(
+                    f"adapter {adapter_id!r} {name}.a shape {a.shape} "
+                    f"incompatible with [L={want_a[0]}, in={want_a[1]}, "
+                    f"r<={self.rank}]"
+                )
+            if (
+                b.shape[0] != self.cfg.n_layers
+                or b.shape[1] != a.shape[2]
+                or b.shape[2:] != out
+            ):
+                raise ValueError(
+                    f"adapter {adapter_id!r} {name}.b shape {b.shape} "
+                    f"incompatible with [L, r={a.shape[2]}, {out}]"
+                )
+            checked[name] = (a, b)
+        with self._lock:
+            slot = self._by_id.get(adapter_id)
+            if slot is None:
+                slot = self._free_slot_locked()
+            for name in self._shapes:
+                self._a[name][:, slot] = 0.0
+                self._b[name][:, slot] = 0.0
+                if name not in checked:
+                    continue
+                a, b = checked[name]
+                r = a.shape[2]
+                self._a[name][:, slot, :, :r] = a
+                # Fold the tenant's alpha/rank scale into b: the device
+                # tree then carries one scale (1.0) for every slot.
+                self._b[name][:, slot, :r] = b * scale
+            self._slot_id[slot] = adapter_id
+            self._by_id[adapter_id] = slot
+            self._last_used[slot] = time.monotonic()
+            self._version += 1
+            METRICS.set(
+                "substratus_serve_adapters_loaded", len(self._by_id)
+            )
+            return slot
+
+    def load(self, adapter_id: str, path: Optional[str] = None) -> int:
+        """Load an adapter artifact into a slot (hot-load path)."""
+        with self._lock:
+            path = path or self._path_of(adapter_id)
+        if path is None:
+            raise UnknownAdapter(adapter_id)
+        layers, scale, _meta = load_adapter_artifact(path)
+        slot = self.install(adapter_id, layers, scale)
+        with self._lock:
+            self._paths[adapter_id] = path
+        return slot
+
+    def _free_slot_locked(self) -> int:
+        """A slot for a new adapter: an empty one, else evict the LRU
+        unpinned resident. Caller holds the lock."""
+        for slot in range(1, self.n_slots):
+            if self._slot_id[slot] is None:
+                return slot
+        victim, oldest = 0, float("inf")
+        for slot in range(1, self.n_slots):
+            if self._refs[slot] == 0 and self._last_used[slot] < oldest:
+                victim, oldest = slot, self._last_used[slot]
+        if victim == 0:
+            raise AdapterCapacityError(
+                f"all {self.capacity} adapter slots are pinned by active "
+                "requests"
+            )
+        evicted = self._slot_id[victim]
+        del self._by_id[evicted]
+        self._slot_id[victim] = None
+        self.stats["evictions"] += 1
+        METRICS.inc("substratus_serve_adapter_evictions_total")
+        METRICS.set("substratus_serve_adapters_loaded", len(self._by_id))
+        return victim
+
+    # -- admission pinning (engine scheduler thread) ------------------------
+
+    def acquire(self, adapter_id: str) -> int:
+        """Resolve an adapter id to its slot for one boarding request,
+        hot-loading from its artifact on a miss, and pin the slot so
+        eviction can't pull the weights out from under an active decode.
+        Raises UnknownAdapter (no artifact anywhere) or
+        AdapterCapacityError (transient: every slot pinned)."""
+        with self._lock:
+            slot = self._by_id.get(adapter_id)
+            if slot is not None:
+                self.stats["hits"] += 1
+                METRICS.inc("substratus_serve_adapter_cache_hits_total")
+                self._refs[slot] += 1
+                self._last_used[slot] = time.monotonic()
+                return slot
+        # Miss: load outside the resolve branch (file IO under the lock
+        # only for the buffer writes inside install()).
+        self.stats["misses"] += 1
+        METRICS.inc("substratus_serve_adapter_cache_misses_total")
+        slot = self.load(adapter_id)
+        with self._lock:
+            self._refs[slot] += 1
+            self._last_used[slot] = time.monotonic()
+            return slot
+
+    def release(self, slot: int) -> None:
+        if slot <= 0:
+            return
+        with self._lock:
+            self._refs[slot] = max(0, self._refs[slot] - 1)
+
+    # -- device tree (engine scheduler thread) ------------------------------
+
+    def device_tree(self, mesh=None) -> Dict[str, Any]:
+        """The stacked adapter tree as device arrays, shaped for the
+        model's layer scan: {"layers": {name: {"a": [L, A, in, r],
+        "b": [L, A, r, ...]}}, "scale": 1.0}. Rebuilt lazily after a
+        mutation; shapes never change, so jitted callers never
+        recompile. Under a mesh the (tiny) tree is replicated."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            version, cached = self._device
+            if cached is not None and version == self._version:
+                return cached
+            layers = {
+                name: {
+                    "a": jnp.asarray(self._a[name], self.dtype),
+                    "b": jnp.asarray(self._b[name], self.dtype),
+                }
+                for name in self._shapes
+            }
+            tree = {"layers": layers, "scale": 1.0}
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                tree = jax.device_put(
+                    tree, NamedSharding(mesh, PartitionSpec())
+                )
+            self._device = (self._version, tree)
+            return tree

@@ -157,6 +157,12 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
+    # Multi-tenant serving (serve/adapters.py): the LoRA adapter id this
+    # request decodes under; None = the base model (identity slot 0).
+    # `adapter_slot` is engine bookkeeping — the store slot pinned for
+    # this request between admission and release.
+    adapter: Optional[str] = None
+    adapter_slot: int = 0
     # Each generated token id is put on this queue; None marks completion.
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     id: str = ""
@@ -213,9 +219,15 @@ class Engine:
         model=llama,
         draft: Optional[tuple] = None,  # (draft_cfg, draft_params)
         sync=None,  # serve.multihost.StepSync for multi-host lockstep
+        adapters=None,  # serve.adapters.AdapterStore for multi-tenant LoRA
     ):
         """model: the model-family module (models.llama, models.opt, ...)
         implementing forward/init_cache/param_logical_axes/cache_logical_axes.
+
+        adapters: an AdapterStore packing N tenants' LoRA adapters into
+        one engine — every jitted function gains (lora_tree, adapter_ids)
+        inputs and each batch row gathers its own adapter by slot index,
+        so a mixed-tenant batch runs in the single compiled program.
 
         mesh: optional jax Mesh for sharded serving. Params are laid out
         by parallel.sharding.serve_rules_for(mesh) (tensor-parallel
@@ -252,6 +264,14 @@ class Engine:
                 f"invalid engine config: max_prefill_len={ec.max_prefill_len} "
                 f"max_batch={ec.max_batch} max_seq_len={ec.max_seq_len}"
             )
+        self.adapters = adapters
+        if adapters is not None and not getattr(
+            model, "SUPPORTS_INDEXED_LORA", False
+        ):
+            raise ValueError(
+                f"multi-tenant adapters unsupported for {model.__name__}"
+            )
+
         kv_int8 = ec.kv_cache_dtype == "int8"
         if kv_int8 and not getattr(model, "SUPPORTS_INT8_KV", False):
             raise ValueError(
@@ -344,6 +364,10 @@ class Engine:
         self.positions = np.zeros((B,), np.int32)
         self.temps = np.zeros((B,), np.float32)
         self.top_ps = np.ones((B,), np.float32)
+        # Per-row adapter slot fed into every jitted call (0 = identity);
+        # slot_adapter mirrors the pins so release can unpin.
+        self.adapter_ids = np.zeros((B,), np.int32)
+        self.slot_adapter: List[int] = [0] * B
         self.key = np.asarray(jax.random.key_data(jax.random.key(0)))
 
         # Host-side slot bookkeeping (scheduler thread only). host_positions
@@ -371,6 +395,7 @@ class Engine:
             "verify_passes": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
+            "adapter_requests": 0,
         }
 
         # Speculative decoding state. The draft pool shares the target's
@@ -451,20 +476,34 @@ class Engine:
     # --- jitted device functions -----------------------------------------
 
     @staticmethod
+    def _lora_kw(lora, adapter_ids) -> dict:
+        """forward() kwargs for the multi-tenant adapter gather — empty
+        when adapters are off, so families without the lora/adapter_ids
+        kwargs (and engines without a store) trace exactly as before."""
+        if lora is None:
+            return {}
+        return {"lora": lora, "adapter_ids": adapter_ids}
+
+    @staticmethod
     @partial(jax.jit, static_argnums=(0, 1))
-    def _prefill_jit(model, cfg, params, tokens, true_len):
+    def _prefill_jit(model, cfg, params, tokens, true_len, lora=None,
+                     adapter_ids=None):
         """tokens [1, Sbucket] (right-padded); returns kv fragment + last
         real token's logits."""
         s = tokens.shape[1]
         positions = jnp.arange(s, dtype=jnp.int32)[None, :]
-        logits, kv = model.forward(params, tokens, cfg, positions=positions)
+        logits, kv = model.forward(
+            params, tokens, cfg, positions=positions,
+            **Engine._lora_kw(lora, adapter_ids),
+        )
         last = logits[0, true_len - 1]
         return last, kv
 
     @staticmethod
     @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
     def _chunk_prefill_jit(model, cfg, params, slot_cache, tokens, offset,
-                           true_len, block_table=None):
+                           true_len, block_table=None, lora=None,
+                           adapter_ids=None):
         """One chunk of a long prefill: tokens [1, C] (right-padded) written
         at absolute positions offset..offset+C-1 — into a single-slot dense
         cache, or through a block-table row [1, M] into the paged pool.
@@ -478,6 +517,7 @@ class Engine:
         # allocates pages through that slot).
         positions = jnp.minimum(positions, offset + true_len)
         kw = {} if block_table is None else {"block_table": block_table}
+        kw.update(Engine._lora_kw(lora, adapter_ids))
         logits, slot_cache = model.forward(
             params, tokens, cfg, positions=positions, cache=slot_cache, **kw
         )
@@ -527,7 +567,7 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def verify(params, cache, block_table, block_tokens, positions0,
-                   temps, top_ps, key_data):
+                   temps, top_ps, key_data, lora=None, adapter_ids=None):
             """ONE target forward over [last, d1..dk] per slot ([B, k+1]).
             Returns (greedy choices [B, k+1], position-0 samples [B] for
             sampling slots, cache, key data)."""
@@ -539,6 +579,7 @@ class Engine:
             logits, cache = model.forward(
                 params, block_tokens, cfg, positions=positions, cache=cache,
                 **({"block_table": block_table} if paged else {}),
+                **Engine._lora_kw(lora, adapter_ids),
             )
             choices = logits.argmax(-1).astype(jnp.int32)
             key, subkey = jax.random.split(jax.random.wrap_key_data(key_data))
@@ -596,7 +637,7 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, block_table, tokens, positions, temps,
-                   top_ps, key_data):
+                   top_ps, key_data, lora=None, adapter_ids=None):
             logits, cache = model.forward(
                 params,
                 tokens[:, None],
@@ -604,6 +645,7 @@ class Engine:
                 positions=positions[:, None],
                 cache=cache,
                 **({"block_table": block_table} if paged else {}),
+                **Engine._lora_kw(lora, adapter_ids),
             )
             key, subkey = jax.random.split(jax.random.wrap_key_data(key_data))
             next_tokens = sample(
@@ -637,11 +679,27 @@ class Engine:
 
     # --- scheduler --------------------------------------------------------
 
+    def _lora_inputs(self):
+        """(lora_tree, adapter_ids) for the jitted batch calls — (None,
+        None) when multi-tenant serving is off, so legacy engines trace
+        the exact pre-adapter signature."""
+        if self.adapters is None:
+            return None, None
+        return self.adapters.device_tree(self.mesh), self.adapter_ids
+
     def submit(self, req: Request) -> Request:
         if self.sync is not None and not self.sync.leader:
             raise RuntimeError(
                 "follower engine: requests arrive via the leader broadcast"
             )
+        if req.adapter is not None:
+            from substratus_tpu.serve.adapters import UnknownAdapter
+
+            # Reject unservable adapters in the CALLER's thread so the
+            # HTTP layer can 404 before anything queues; actual loading
+            # and pinning happen at admission on the scheduler thread.
+            if self.adapters is None or not self.adapters.known(req.adapter):
+                raise UnknownAdapter(req.adapter)
         if self.error is not None:
             req.finish_reason = "error"
             req.out.put(None)  # engine is dead; never strand the caller
@@ -741,6 +799,7 @@ class Engine:
                         top_p=d["tp"],
                         eos_token_id=d["e"],
                         id=d["id"],
+                        adapter=d.get("ad"),
                         out=NullSink(),
                         sync_id=d["sid"],
                     )
@@ -782,6 +841,16 @@ class Engine:
             if req is None:
                 break
             self._admitting = req
+            verdict = self._acquire_adapter(req)
+            if verdict == "dead":
+                self._admitting = None
+                continue
+            if verdict == "wait":
+                # Transient: every adapter slot is pinned by an active
+                # request. Hold at the front; decoding slots will unpin.
+                self._admitting = None
+                self._resume.insert(0, req)
+                break
             slot = int(np.flatnonzero(~self.active)[0])
             # Queue wait is submission -> first prefill; a preempted
             # request re-boarding (last_emit_ts set) already paid it.
@@ -808,7 +877,9 @@ class Engine:
             self._admitting = None
             if not ok:
                 # Pool dry even after eviction: hold the request at the
-                # front of the line; decoding slots will free pages.
+                # front of the line; decoding slots will free pages. The
+                # adapter pin drops too — re-admission re-acquires.
+                self._release_adapter_pin(req)
                 self._resume.insert(0, req)
                 break
             admitted += 1
@@ -817,6 +888,56 @@ class Engine:
         )
         return admitted
 
+    def _acquire_adapter(self, req: Request) -> str:
+        """Resolve + pin the request's adapter before prefill. Returns
+        'ok' (adapter_slot set; 0 = base), 'wait' (every store slot is
+        pinned — transient, hold the request), or 'dead' (adapter
+        unknown/unloadable — request finished with an error marker)."""
+        req.adapter_slot = 0
+        if req.adapter is None:
+            return "ok"
+        from substratus_tpu.serve.adapters import (
+            AdapterCapacityError,
+            UnknownAdapter,
+        )
+
+        try:
+            if self.adapters is None:
+                raise UnknownAdapter(req.adapter)
+            req.adapter_slot = self.adapters.acquire(req.adapter)
+            self.stats["adapter_requests"] += 1
+            return "ok"
+        except AdapterCapacityError:
+            return "wait"
+        except (UnknownAdapter, OSError, ValueError) as e:
+            # The artifact vanished (or corrupted) between submit()'s
+            # known() check and admission: fail THIS request, not the
+            # engine.
+            logging.getLogger(__name__).warning(
+                "adapter %r failed to load for request %s: %s",
+                req.adapter, req.id, e,
+            )
+            req.finish_reason = "error"
+            req.out.put(None)
+            if req.sync_id is not None:
+                self._sync_reqs.pop(req.sync_id, None)
+            return "dead"
+
+    def _release_adapter_pin(self, req: Request) -> None:
+        if self.adapters is not None and req.adapter_slot:
+            self.adapters.release(req.adapter_slot)
+        req.adapter_slot = 0
+
+    def _prefill_lora(self, req: Request):
+        """(lora_tree, [1]-shaped adapter id) for one request's prefill
+        dispatch; (None, None) when multi-tenant serving is off."""
+        if self.adapters is None:
+            return None, None
+        return (
+            self.adapters.device_tree(self.mesh),
+            np.array([req.adapter_slot], np.int32),
+        )
+
     def _admit_dense(self, req: Request, slot: int) -> bool:
         # Keep the newest tokens that fit the cache (minus one slot for
         # generation); prompts longer than one prefill bucket run as a
@@ -824,16 +945,17 @@ class Engine:
         keep = self.ec.max_seq_len - 1
         prompt = req.prompt_tokens[-keep:]
         true_len = len(prompt)
+        lora, ids1 = self._prefill_lora(req)
         if true_len <= self.ec.max_prefill_len:
             padded, true_len = _pad_to_bucket(
                 prompt, self.ec.max_prefill_len
             )
             last_logits, kv = self._prefill_fn(
-                self.params, padded, true_len
+                self.params, padded, true_len, lora, ids1
             )
             self.cache = self._insert_fn(self.cache, kv, slot)
         else:
-            last_logits = self._chunked_prefill(prompt, slot)
+            last_logits = self._chunked_prefill(prompt, slot, lora, ids1)
         self.stats["prefill_tokens"] += true_len
         self._finalize_admit(req, slot, last_logits, true_len)
         return True
@@ -851,8 +973,13 @@ class Engine:
         prompt = req.prompt_tokens[-keep:] or [0]
         true_len = len(prompt)
 
+        # Prefix chains are salted with the adapter id: K/V written
+        # under one tenant's wk/wv deltas must never seed another
+        # tenant's (or the base model's) prompt.
         entries = (
-            chain_entries(prompt, bs) if self.prefix is not None else []
+            chain_entries(prompt, bs, salt=req.adapter)
+            if self.prefix is not None
+            else []
         )
         # Reuse at most the pages strictly before the last prompt token:
         # the last token must run through the model for its logits.
@@ -884,8 +1011,10 @@ class Engine:
         self.block_table[slot] = row
         bt_row = self.block_table[slot : slot + 1].copy()
 
+        lora, ids1 = self._prefill_lora(req)
         last_logits, self.cache = self._run_chunks(
-            self._chunk_fn, self.params, self.cache, prompt, reuse, bt_row
+            self._chunk_fn, self.params, self.cache, prompt, reuse, bt_row,
+            lora=lora, adapter_ids=ids1,
         )
         self.stats["prefill_tokens"] += true_len - reuse
         self.stats["prefix_hit_tokens"] += reuse
@@ -908,7 +1037,8 @@ class Engine:
         self._finalize_admit(req, slot, last_logits, true_len)
         return True
 
-    def _run_chunks(self, fn, params, cache, prompt, start: int, bt_row):
+    def _run_chunks(self, fn, params, cache, prompt, start: int, bt_row,
+                    lora=None, adapter_ids=None):
         """Chunked prefill of prompt[start:] through a block-table row;
         returns (last real token's logits, updated cache)."""
         chunk = self.ec.max_prefill_len
@@ -918,7 +1048,8 @@ class Engine:
                 prompt[offset : offset + chunk], chunk
             )
             last_logits, cache = fn(
-                params, cache, padded, offset, clen, block_table=bt_row
+                params, cache, padded, offset, clen, block_table=bt_row,
+                lora=lora, adapter_ids=adapter_ids,
             )
             offset += clen
         return last_logits, cache
@@ -943,6 +1074,8 @@ class Engine:
 
         self.slot_req[slot] = req
         self.slot_generated[slot] = 0
+        self.slot_adapter[slot] = req.adapter_slot
+        self.adapter_ids[slot] = req.adapter_slot
         self.active[slot] = True
         self.host_positions[slot] = true_len
         self.slot_tokens[slot] = []
@@ -1038,6 +1171,7 @@ class Engine:
                 self._ensure_capacity(int(slot))
             if not self.active.any():
                 return
+        lora, adapter_ids = self._lora_inputs()
         next_tokens, self.cache, key_out = self._decode_fn(
             self.params,
             self.cache,
@@ -1047,6 +1181,8 @@ class Engine:
             self.temps,
             self.top_ps,
             self.key,
+            lora,
+            adapter_ids,
         )
         self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side so lockstep processes feed identical replicated inputs
         # Clamp at the last cache row: active slots are released at the
@@ -1152,9 +1288,11 @@ class Engine:
         else:
             props = lookup_props
         block = np.concatenate([self.tokens[:, None], props], axis=1)
+        lora, adapter_ids = self._lora_inputs()
         choices, sampled, self.cache, key_out = self._verify_fn(
             self.params, self.cache, bt, block,
             self.positions, self.temps, self.top_ps, self.key,
+            lora, adapter_ids,
         )
         self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side (lockstep replication contract)
         self.stats["verify_passes"] += 1
@@ -1205,6 +1343,12 @@ class Engine:
         self.active[slot] = False
         self.slot_req[slot] = None
         self.slot_tokens[slot] = []
+        if self.adapters is not None and self.slot_adapter[slot]:
+            self.adapters.release(self.slot_adapter[slot])
+        self.slot_adapter[slot] = 0
+        # Idle rows gather the identity adapter: their decode writes
+        # keep happening (static shapes) and must stay adapter-free.
+        self.adapter_ids[slot] = 0
         if self.paged:
             self.slot_pages.release(slot, self.alloc)
             # Point the idle slot back at the trash page; its decode writes
@@ -1212,13 +1356,15 @@ class Engine:
             # the allocator may hand to someone else.
             self.block_table[slot] = 0
 
-    def _chunked_prefill(self, prompt, slot: int):
+    def _chunked_prefill(self, prompt, slot: int, lora=None,
+                         adapter_ids=None):
         """Prefill a prompt longer than one bucket: run bucket-sized chunks
         against the slot's cache (each chunk attends everything before it),
         then restore the slot into the decode cache."""
         slot_cache = self._extract_slot(self.cache, slot)
         last_logits, slot_cache = self._run_chunks(
-            self._chunk_fn, self.params, slot_cache, prompt, 0, None
+            self._chunk_fn, self.params, slot_cache, prompt, 0, None,
+            lora=lora, adapter_ids=adapter_ids,
         )
         self.cache = self._restore_slot(self.cache, slot_cache, slot)
         return last_logits
@@ -1360,13 +1506,24 @@ class Engine:
             kv_free = self.alloc.free_pages / max(1, self.n_pages)
         else:
             kv_free = (self.ec.max_batch - active) / self.ec.max_batch
-        return {
+        snap = {
             "queue_depth": self.queue.qsize() + len(self._resume),
             "active_slots": active,
             "max_slots": self.ec.max_batch,
             "kv_free_frac": round(kv_free, 4),
             "max_queue": self.ec.max_queue,
         }
+        if self.adapters is not None:
+            # Resident adapter ids + hit/miss/evict counters: the
+            # gateway's affinity scoring reads `adapters` (loadreport.py
+            # piggybacks it as `ad=` on x-substratus-load).
+            a = self.adapters.snapshot()
+            snap["adapters"] = a["loaded"]
+            snap["adapter_capacity"] = a["capacity"]
+            snap["adapter_hits"] = a["hits"]
+            snap["adapter_misses"] = a["misses"]
+            snap["adapter_evictions"] = a["evictions"]
+        return snap
 
     # --- synchronous helper (tests / bench) -------------------------------
 

@@ -104,6 +104,12 @@ def main(argv=None) -> int:
         "--spec-k", type=int, default=None,
         help="draft tokens proposed per verify pass (0 = off)",
     )
+    ap.add_argument(
+        "--adapters-dir", default=None,
+        help="directory of LoRA adapter artifacts served multi-tenant "
+             "(one subdir per adapter id; default /content/adapters "
+             "when mounted — docs/serving.md 'Multi-tenant adapters')",
+    )
     args = ap.parse_args(argv)
 
     from substratus_tpu.utils.jaxenv import honor_requested_platform
@@ -139,7 +145,7 @@ def main(argv=None) -> int:
             "max_prefill_len", "kv_cache_dtype", "kv_layout", "attn_impl",
             "chunk_attn_impl", "decode_attn_impl", "q4_impl", "tensor",
             "sequence", "replicas", "draft_model", "spec_k", "max_queue",
-            "drain_grace",
+            "drain_grace", "adapters", "baseModel",
         ),
         "serve.main",
     )
@@ -354,8 +360,70 @@ def main(argv=None) -> int:
             flush=True,
         )
 
+    # Multi-tenant adapter serving (docs/serving.md "Multi-tenant
+    # adapters"): pack N tenants' LoRA adapters into this one engine.
+    # Sources: --adapters-dir / params.json {"adapters": {"dir": ...,
+    # "paths": {id: path}, "capacity", "rank", "targets"}}, defaulting
+    # to the container-contract /content/adapters mount when present.
+    adapters = None
+    adapters_cfg = params_json.get("adapters") or {}
+    adapters_dir = args.adapters_dir or adapters_cfg.get("dir") or (
+        "/content/adapters" if os.path.isdir("/content/adapters") else None
+    )
+    if adapters_dir or adapters_cfg.get("paths"):
+        if not getattr(family, "SUPPORTS_INDEXED_LORA", False):
+            # Same loud-not-silent policy as _maybe_quantize: tell the
+            # operator their tenants won't be served instead of 404ing
+            # every adapter request with no explanation in the logs.
+            print(
+                "multi-tenant adapters unsupported for this family; "
+                "serving the base model only",
+                flush=True,
+            )
+        else:
+            from substratus_tpu.serve.adapters import (
+                AdapterStore, infer_store_shape, is_adapter_artifact,
+            )
+
+            explicit = dict(adapters_cfg.get("paths") or {})
+            discovered = {}
+            if adapters_dir and os.path.isdir(adapters_dir):
+                for entry in sorted(os.listdir(adapters_dir)):
+                    p = os.path.join(adapters_dir, entry)
+                    if is_adapter_artifact(p):
+                        discovered[entry] = p
+            inferred_rank, inferred_targets = infer_store_shape(
+                list(explicit.values()) + list(discovered.values())
+            )
+            adapters = AdapterStore(
+                cfg,
+                capacity=int(adapters_cfg.get("capacity", 8)),
+                rank=int(adapters_cfg.get("rank", inferred_rank)),
+                targets=tuple(
+                    adapters_cfg.get("targets", inferred_targets)
+                ),
+                search_dir=adapters_dir,
+            )
+            for aid, p in explicit.items():
+                adapters.register_path(aid, p)
+            # Preload up to capacity so first requests don't pay the
+            # artifact read; the rest hot-load on demand (cache miss).
+            for aid in list(adapters.available_ids())[: adapters.capacity]:
+                try:
+                    adapters.load(aid)
+                except (OSError, ValueError) as e:
+                    print(f"adapter {aid!r} failed to preload: {e}",
+                          flush=True)
+            print(
+                f"adapter store: {len(adapters.loaded_ids())} loaded / "
+                f"{len(adapters.available_ids())} available "
+                f"(capacity {adapters.capacity}, rank {adapters.rank})",
+                flush=True,
+            )
+
     engine = Engine(
-        cfg, params, ec, mesh=mesh, model=family, draft=draft, sync=sync
+        cfg, params, ec, mesh=mesh, model=family, draft=draft, sync=sync,
+        adapters=adapters,
     )
     engine.start()
     if sync is not None and not sync.leader:

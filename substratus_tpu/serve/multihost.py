@@ -238,6 +238,7 @@ def encode_events(reqs: List[Any], cancels: List[int], stop: bool) -> bytes:
                     "tp": r.top_p,
                     "e": r.eos_token_id,
                     "id": r.id,
+                    "ad": r.adapter,
                 }
                 for r in reqs
             ],

@@ -69,15 +69,20 @@ class PageAllocator:
 
 
 def chain_entries(
-    tokens: Sequence[int], page_size: int
+    tokens: Sequence[int], page_size: int, salt: object = None
 ) -> List[Tuple[int, int, Tuple[int, ...]]]:
     """Per FULL page: (chain_hash, parent_hash, page_tokens). The chain hash
     commits to every token before the page — but hash() is not collision-
     proof on user-controlled token sequences, so the registry also verifies
     (parent_hash, page_tokens) on match: with the parent link verified
-    inductively, equal page tokens imply the whole prefix matches."""
+    inductively, equal page tokens imply the whole prefix matches.
+
+    `salt` seeds the chain root: multi-tenant serving passes the request's
+    adapter id so a prompt prefilled under one LoRA adapter (whose wk/wv
+    deltas change the cached K/V values) can never be reused by another
+    tenant — same tokens, different adapter, disjoint chains."""
     out: List[Tuple[int, int, Tuple[int, ...]]] = []
-    h = 0
+    h = 0 if salt is None else hash(("adapter-salt", salt))
     for i in range(len(tokens) // page_size):
         page = tuple(tokens[i * page_size : (i + 1) * page_size])
         parent = h

@@ -32,6 +32,7 @@ from substratus_tpu.observability.httpstats import count_http_response
 from substratus_tpu.observability.metrics import METRICS
 from substratus_tpu.observability.propagation import parse_traceparent
 from substratus_tpu.observability.tracing import tracer
+from substratus_tpu.serve.adapters import UnknownAdapter
 from substratus_tpu.serve.engine import Engine, EngineOverloaded, Request
 from substratus_tpu.serve.tokenizer import Tokenizer
 
@@ -259,12 +260,15 @@ async def trace_middleware(request: web.Request, handler):
 
 
 def _completion_body(state: ServerState, text: str, n_prompt: int,
-                     n_gen: int, finish_reason: str = "stop"):
+                     n_gen: int, finish_reason: str = "stop",
+                     model: Optional[str] = None):
     return {
         "id": f"cmpl-{uuid.uuid4().hex[:24]}",
         "object": "text_completion",
         "created": int(time.time()),
-        "model": state.model_name,
+        # Echo the tenant the request named (OpenAI semantics); the
+        # base model's name when none was given.
+        "model": model or state.model_name,
         "choices": [
             {
                 "index": 0,
@@ -687,18 +691,28 @@ def build_app(state: ServerState) -> web.Application:
 
     @routes.get("/v1/models")
     async def models(request: web.Request) -> web.Response:
-        return web.json_response(
+        data = [
             {
-                "object": "list",
-                "data": [
-                    {
-                        "id": state.model_name,
-                        "object": "model",
-                        "owned_by": "substratus-tpu",
-                    }
-                ],
+                "id": state.model_name,
+                "object": "model",
+                "owned_by": "substratus-tpu",
             }
-        )
+        ]
+        if state.engine.adapters is not None:
+            # Every servable tenant adapter is a model clients can name
+            # in the OpenAI `model` field (loaded or hot-loadable).
+            loaded = set(state.engine.adapters.loaded_ids())
+            data.extend(
+                {
+                    "id": aid,
+                    "object": "model",
+                    "owned_by": "substratus-tpu",
+                    "parent": state.model_name,
+                    "loaded": aid in loaded,
+                }
+                for aid in state.engine.adapters.available_ids()
+            )
+        return web.json_response({"object": "list", "data": data})
 
     def _validate_body(body: dict) -> None:
         """Reject malformed request knobs BEFORE any engine work happens
@@ -758,6 +772,26 @@ def build_app(state: ServerState) -> web.Application:
                 content_type="application/json",
             )
 
+    def _resolve_adapter(body: dict) -> Optional[str]:
+        """The OpenAI `model` field -> an engine adapter id. The base
+        model's own name (or an absent/empty field) means no adapter;
+        anything else must be a servable adapter or the request is a
+        404 before any engine work."""
+        name = body.get("model")
+        if not name or name == state.model_name:
+            return None
+        eng = state.engine
+        if eng.adapters is not None and eng.adapters.known(str(name)):
+            return str(name)
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": {
+                "message": f"model {name!r} not found",
+                "type": "invalid_request_error",
+                "code": "model_not_found",
+            }}),
+            content_type="application/json",
+        )
+
     def _submit(prompt: str, body: dict, endpoint: str,
                 templated: bool = False) -> Request:
         tok = state.tokenizer
@@ -767,11 +801,23 @@ def build_app(state: ServerState) -> web.Application:
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
             eos_token_id=tok.eos_id,
+            adapter=_resolve_adapter(body),
             id=uuid.uuid4().hex,
         )
         state.track_request(req, endpoint)
         try:
             return state.engine.submit(req)
+        except UnknownAdapter as e:
+            # The artifact vanished between the known() check and
+            # submit — same client-visible contract as _resolve_adapter.
+            state.untrack_request(req)
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": {
+                    "message": str(e), "type": "invalid_request_error",
+                    "code": "model_not_found",
+                }}),
+                content_type="application/json",
+            )
         except EngineOverloaded as e:
             state.untrack_request(req)
             # Bounded queue -> explicit shed: 429 + Retry-After beats
@@ -845,6 +891,7 @@ def build_app(state: ServerState) -> web.Application:
         loop = asyncio.get_running_loop()
         created = int(time.time())
         cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        resp_model = str(body.get("model") or state.model_name)
 
         async def write_piece(piece: str, finish=None):
             if chat:
@@ -858,7 +905,7 @@ def build_app(state: ServerState) -> web.Application:
                 "id": cid,
                 "object": obj,
                 "created": created,
-                "model": state.model_name,
+                "model": resp_model,
                 "choices": [choice],
             }
             await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
@@ -969,7 +1016,8 @@ def build_app(state: ServerState) -> web.Application:
             span.set_attribute("completion_tokens", n_gen)
             span.set_attribute("finish_reason", finish)
         return web.json_response(
-            _completion_body(state, text, n_prompt, n_gen, finish)
+            _completion_body(state, text, n_prompt, n_gen, finish,
+                             model=body.get("model"))
         )
 
     @routes.post("/v1/chat/completions")
@@ -994,7 +1042,8 @@ def build_app(state: ServerState) -> web.Application:
             text, n_prompt, n_gen, finish = await _generate(
                 request, prompt, body, templated
             )
-        resp = _completion_body(state, text, n_prompt, n_gen, finish)
+        resp = _completion_body(state, text, n_prompt, n_gen, finish,
+                                model=body.get("model"))
         resp["object"] = "chat.completion"
         resp["choices"] = [
             {

@@ -308,6 +308,21 @@ def main(argv=None) -> int:
         else trainer.params
     )
     save_artifact(args.out, final, cfg, extra_meta={"trained_steps": steps})
+    if trainer.lora is not None:
+        # Alongside the merged model: the raw adapter as a multi-tenant
+        # serving artifact (serve/adapters.py; docs/container-contract.md
+        # "Adapter artifacts") — a Server sharing this model's base mounts
+        # {artifacts}/adapter under /content/adapters/<tenant>.
+        from substratus_tpu.serve.adapters import save_adapter_artifact
+
+        save_adapter_artifact(
+            os.path.join(args.out, "adapter"),
+            trainer.lora,
+            alpha=float(p.get("lora_alpha", 16.0)),
+            rank=lora_rank,
+            extra_meta={"trained_steps": steps},
+        )
+        print(f"adapter artifact saved to {args.out}/adapter", flush=True)
     print(f"artifact saved to {args.out}", flush=True)
     return 0
 

@@ -1,0 +1,520 @@
+"""Multi-tenant adapter serving (serve/adapters.py, ISSUE 6).
+
+The tier-1 gates here:
+
+  * PARITY — greedy decode through the slot-indexed adapter path must
+    be token-exact against an engine built from merge_lora(base,
+    adapter) merged weights, and the identity slot must leave the base
+    model untouched;
+  * ISOLATION — a mixed-tenant batch decodes every row under its own
+    adapter (no cross-talk), and prefix-cache pages never cross
+    tenants;
+  * LIFECYCLE — hot-load on miss, LRU evict of unpinned residents,
+    pinned slots survive pressure;
+  * SURFACE — the OpenAI `model` field maps to adapters on the server
+    (404 for strangers), /loadz + x-substratus-load carry resident ids,
+    and the gateway balancer prefers resident replicas.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from substratus_tpu.models import llama
+from substratus_tpu.serve.adapters import (
+    AdapterCapacityError,
+    AdapterStore,
+    UnknownAdapter,
+    infer_store_shape,
+    load_adapter_artifact,
+    save_adapter_artifact,
+)
+from substratus_tpu.serve.engine import Engine, EngineConfig, Request
+from substratus_tpu.serve.tokenizer import ByteTokenizer
+from substratus_tpu.train.lora import init_lora, merge_lora
+
+RANK, ALPHA = 4, 8.0
+SCALE = ALPHA / RANK
+
+
+def tiny_cfg():
+    return llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_cfg()
+
+
+@pytest.fixture(scope="module")
+def base_params(cfg):
+    return llama.init_params(cfg, jax.random.key(0))
+
+
+def make_lora(cfg, seed, magnitude=0.05):
+    """A LoRA tree whose B is RANDOMIZED — init_lora's zero B would make
+    every adapter a no-op and the parity test vacuous."""
+    tree = init_lora(
+        cfg, jax.random.key(seed), rank=RANK, alpha=ALPHA, dtype=jnp.float32
+    )
+    for i, name in enumerate(sorted(tree)):
+        tree[name]["b"] = (
+            jax.random.normal(
+                jax.random.key(1000 + seed * 7 + i), tree[name]["b"].shape,
+                jnp.float32,
+            ) * magnitude
+        )
+    return tree
+
+
+def host_tree(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def ec(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("eos_token_id", 257)
+    return EngineConfig(**kw)
+
+
+def run_engine(cfg, params, engine_cfg=None, adapters=None):
+    eng = Engine(cfg, params, engine_cfg or ec(), adapters=adapters)
+    eng.start()
+    return eng
+
+
+PROMPT = [256, 10, 20, 30]
+
+
+# --- the store itself ----------------------------------------------------
+
+
+def test_store_shapes_and_identity_slot(cfg):
+    store = AdapterStore(cfg, capacity=2, rank=RANK, dtype=jnp.float32)
+    tree = store.device_tree()
+    assert tree["scale"] == 1.0
+    a = tree["layers"]["wq"]["a"]
+    # [L, A, in, r] with A = capacity + identity slot
+    assert a.shape == (cfg.n_layers, 3, cfg.dim, RANK)
+    assert not np.asarray(a[:, 0]).any(), "identity slot must stay zero"
+
+
+def test_store_install_rank_padding_and_scale_fold(cfg):
+    store = AdapterStore(cfg, capacity=2, rank=RANK + 4, dtype=jnp.float32)
+    lora = host_tree(make_lora(cfg, 3))
+    slot = store.install("t", lora, scale=SCALE)
+    assert slot == 1
+    dev = store.device_tree()
+    a = np.asarray(dev["layers"]["wq"]["a"][:, slot])
+    b = np.asarray(dev["layers"]["wq"]["b"][:, slot])
+    np.testing.assert_allclose(a[:, :, :RANK], lora["wq"]["a"], rtol=1e-6)
+    assert not a[:, :, RANK:].any(), "extra rank columns must zero-pad"
+    np.testing.assert_allclose(
+        b[:, :RANK], lora["wq"]["b"] * SCALE, rtol=1e-6
+    )
+
+
+def test_store_rejects_bad_shapes_and_targets(cfg):
+    store = AdapterStore(cfg, capacity=1, rank=RANK, dtype=jnp.float32)
+    lora = host_tree(make_lora(cfg, 4))
+    with pytest.raises(ValueError, match="not in the store's target set"):
+        store.install("t", {"nope": lora["wq"]})
+    bad = {"wq": {"a": lora["wq"]["a"][:, :, :1][:, :1], "b": lora["wq"]["b"]}}
+    with pytest.raises(ValueError, match="incompatible"):
+        store.install("t", bad)
+    # A failed re-install must not corrupt the resident slot.
+    store.install("t", lora, scale=SCALE)
+    before = np.asarray(store.device_tree()["layers"]["wq"]["a"][:, 1]).copy()
+    with pytest.raises(ValueError):
+        store.install("t", bad)
+    after = np.asarray(store.device_tree()["layers"]["wq"]["a"][:, 1])
+    np.testing.assert_array_equal(before, after)
+
+
+def test_store_lru_evicts_unpinned_only(cfg):
+    store = AdapterStore(cfg, capacity=2, rank=RANK, dtype=jnp.float32)
+    store.install("a", host_tree(make_lora(cfg, 5)), SCALE)
+    store.install("b", host_tree(make_lora(cfg, 6)), SCALE)
+    slot_a = store.acquire("a")  # pin a; b is the LRU *unpinned* victim
+    store.install("c", host_tree(make_lora(cfg, 7)), SCALE)
+    assert store.loaded_ids() == ["a", "c"]
+    assert store.stats["evictions"] == 1
+    # Both survivors pinned -> capacity error, not an eviction of "a".
+    store.acquire("c")
+    with pytest.raises(AdapterCapacityError):
+        store.install("d", host_tree(make_lora(cfg, 8)), SCALE)
+    store.release(slot_a)
+    store.install("d", host_tree(make_lora(cfg, 8)), SCALE)
+    assert "d" in store.loaded_ids() and "a" not in store.loaded_ids()
+
+
+def test_artifact_roundtrip_and_discovery(cfg, tmp_path):
+    lora = host_tree(make_lora(cfg, 9))
+    path = tmp_path / "my-tuned"
+    save_adapter_artifact(str(path), lora, alpha=ALPHA, rank=RANK)
+    layers, scale, meta = load_adapter_artifact(str(path))
+    assert scale == pytest.approx(SCALE)
+    assert meta["lora"]["targets"] == sorted(lora)
+    for name in lora:
+        np.testing.assert_allclose(layers[name]["a"], lora[name]["a"])
+    # infer_store_shape reads the artifact metadata back.
+    rank, targets = infer_store_shape([str(path)])
+    assert rank == RANK and targets == tuple(sorted(lora))
+
+    store = AdapterStore(
+        cfg, capacity=2, rank=RANK, dtype=jnp.float32,
+        search_dir=str(tmp_path),
+    )
+    assert store.known("my-tuned") and not store.loaded_ids()
+    assert store.available_ids() == ["my-tuned"]
+    slot = store.acquire("my-tuned")  # the miss path IS the hot-load path
+    assert slot == 1 and store.loaded_ids() == ["my-tuned"]
+    assert store.stats["misses"] == 1
+    assert not store.known("stranger")
+    with pytest.raises(UnknownAdapter):
+        store.load("stranger")
+
+
+# --- parity (the tier-1 gate) -------------------------------------------
+
+
+@pytest.mark.parametrize("kv_layout", ["paged", "dense"])
+def test_greedy_parity_with_merged_weights(cfg, base_params, kv_layout):
+    """ISSUE 6 acceptance: greedy decode through the slot-indexed
+    adapter path bit-matches an engine built from merge_lora merged
+    weights, on both KV layouts; the identity slot bit-matches the
+    plain base engine."""
+    lora = make_lora(cfg, 11)
+    store = AdapterStore(cfg, capacity=2, rank=RANK, dtype=jnp.float32)
+    store.install("tuned", host_tree(lora), SCALE)
+
+    packed = run_engine(cfg, base_params, ec(kv_layout=kv_layout), store)
+    try:
+        got_base = packed.generate(PROMPT, max_tokens=10, temperature=0.0)
+        got_tuned = packed.generate(
+            PROMPT, max_tokens=10, temperature=0.0, adapter="tuned"
+        )
+    finally:
+        packed.stop()
+
+    plain = run_engine(cfg, base_params, ec(kv_layout=kv_layout))
+    try:
+        want_base = plain.generate(PROMPT, max_tokens=10, temperature=0.0)
+    finally:
+        plain.stop()
+
+    merged = run_engine(
+        cfg, merge_lora(base_params, lora, SCALE), ec(kv_layout=kv_layout)
+    )
+    try:
+        want_tuned = merged.generate(PROMPT, max_tokens=10, temperature=0.0)
+    finally:
+        merged.stop()
+
+    assert got_base == want_base, "identity slot changed the base model"
+    assert got_tuned == want_tuned, "slot-indexed path != merged weights"
+    assert got_tuned != got_base, "adapter had no effect (vacuous parity)"
+
+
+def test_mixed_adapter_batch_no_crosstalk(cfg, base_params):
+    """Two tenants + the base decoding CONCURRENTLY in one engine each
+    match their dedicated single-model engines — the per-row gather
+    really is per row."""
+    loras = {"t1": make_lora(cfg, 21), "t2": make_lora(cfg, 22)}
+    store = AdapterStore(cfg, capacity=3, rank=RANK, dtype=jnp.float32)
+    for name, tree in loras.items():
+        store.install(name, host_tree(tree), SCALE)
+
+    want = {}
+    for name, tree in loras.items():
+        eng = run_engine(cfg, merge_lora(base_params, tree, SCALE))
+        try:
+            want[name] = eng.generate(PROMPT, max_tokens=8, temperature=0.0)
+        finally:
+            eng.stop()
+    eng = run_engine(cfg, base_params)
+    try:
+        want[None] = eng.generate(PROMPT, max_tokens=8, temperature=0.0)
+    finally:
+        eng.stop()
+
+    packed = run_engine(cfg, base_params, adapters=store)
+    try:
+        plan = ["t1", "t2", None, "t2", "t1", None]
+        results: list = [None] * len(plan)
+
+        def run(i):
+            results[i] = packed.generate(
+                PROMPT, max_tokens=8, temperature=0.0, adapter=plan[i]
+            )
+
+        threads = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(len(plan))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert packed.stats["adapter_requests"] == 4
+    finally:
+        packed.stop()
+    for i, name in enumerate(plan):
+        assert results[i] == want[name], f"row {i} ({name}) cross-talked"
+
+
+def test_prefix_cache_does_not_cross_tenants(cfg, base_params):
+    """Same prompt, different adapter: the second request must MISS the
+    prefix registry (adapter-salted chains) — shared pages hold K/V
+    computed under the first tenant's wk/wv deltas."""
+    lora = make_lora(cfg, 31)
+    store = AdapterStore(cfg, capacity=2, rank=RANK, dtype=jnp.float32)
+    store.install("tuned", host_tree(lora), SCALE)
+    # page_size 4 so a 20-token prompt spans full pages
+    prompt = [256] + list(range(1, 20))
+    eng = run_engine(
+        cfg, base_params, ec(kv_layout="paged", page_size=4), store
+    )
+    try:
+        eng.generate(prompt, max_tokens=2, temperature=0.0)
+        base_hits = eng.stats["prefix_hit_tokens"]
+        eng.generate(prompt, max_tokens=2, temperature=0.0, adapter="tuned")
+        assert eng.stats["prefix_hit_tokens"] == base_hits, (
+            "tenant reused the base model's prefix pages"
+        )
+        # Same tenant again: NOW sharing is correct (and expected).
+        eng.generate(prompt, max_tokens=2, temperature=0.0, adapter="tuned")
+        assert eng.stats["prefix_hit_tokens"] > base_hits
+    finally:
+        eng.stop()
+
+
+# --- lifecycle through the engine ---------------------------------------
+
+
+def test_engine_hot_load_and_evict(cfg, base_params, tmp_path):
+    """Capacity-1 store, two artifacts on disk: the engine hot-loads
+    each tenant on demand, evicting the other — and the outputs still
+    match the dedicated merged engines."""
+    loras = {"t1": make_lora(cfg, 41), "t2": make_lora(cfg, 42)}
+    for name, tree in loras.items():
+        save_adapter_artifact(
+            str(tmp_path / name), host_tree(tree), alpha=ALPHA, rank=RANK
+        )
+    store = AdapterStore(
+        cfg, capacity=1, rank=RANK, dtype=jnp.float32,
+        search_dir=str(tmp_path),
+    )
+    eng = run_engine(cfg, base_params, adapters=store)
+    got = {}
+    try:
+        for name in ("t1", "t2", "t1"):
+            got[name] = eng.generate(
+                PROMPT, max_tokens=6, temperature=0.0, adapter=name
+            )
+        assert store.stats["misses"] == 3  # every switch re-loads
+        assert store.stats["evictions"] == 2
+        assert store.loaded_ids() == ["t1"]
+        with pytest.raises(UnknownAdapter):
+            eng.submit(Request(PROMPT, adapter="stranger"))
+    finally:
+        eng.stop()
+    for name, tree in loras.items():
+        ref = run_engine(cfg, merge_lora(base_params, tree, SCALE))
+        try:
+            assert got[name] == ref.generate(
+                PROMPT, max_tokens=6, temperature=0.0
+            )
+        finally:
+            ref.stop()
+
+
+def test_load_snapshot_reports_adapters(cfg, base_params):
+    store = AdapterStore(cfg, capacity=2, rank=RANK, dtype=jnp.float32)
+    store.install("t", host_tree(make_lora(cfg, 51)), SCALE)
+    eng = run_engine(cfg, base_params, adapters=store)
+    try:
+        snap = eng.load_snapshot()
+        assert snap["adapters"] == ["t"]
+        assert snap["adapter_capacity"] == 2
+        assert {"adapter_hits", "adapter_misses", "adapter_evictions"} <= set(
+            snap
+        )
+    finally:
+        eng.stop()
+
+
+# --- HTTP surface --------------------------------------------------------
+
+
+def test_server_model_field_maps_to_adapter(cfg, base_params):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.gateway.loadreport import HEADER, LoadReport
+    from substratus_tpu.serve.server import ServerState, build_app
+
+    lora = make_lora(cfg, 61)
+    store = AdapterStore(cfg, capacity=2, rank=RANK, dtype=jnp.float32)
+    store.install("my-tuned", host_tree(lora), SCALE)
+    eng = run_engine(cfg, base_params, adapters=store)
+    state = ServerState(eng, ByteTokenizer(), "tiny")
+
+    async def go():
+        app = build_app(state)
+        async with TestClient(TestServer(app)) as client:
+            # /v1/models advertises base + tenants.
+            r = await client.get("/v1/models")
+            data = (await r.json())["data"]
+            ids = {m["id"] for m in data}
+            assert {"tiny", "my-tuned"} <= ids
+            tenant = next(m for m in data if m["id"] == "my-tuned")
+            assert tenant["parent"] == "tiny" and tenant["loaded"] is True
+
+            # model=<tenant> serves the adapter and echoes the name.
+            payload = {"prompt": "hi", "max_tokens": 4, "temperature": 0.0}
+            r = await client.post(
+                "/v1/completions", json={**payload, "model": "my-tuned"}
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["model"] == "my-tuned"
+            # The load header piggybacks resident adapter ids.
+            rep = LoadReport.from_header(r.headers[HEADER])
+            assert rep.adapters == ("my-tuned",)
+            tuned_text = body["choices"][0]["text"]
+
+            # base-name and absent model both mean "no adapter".
+            r = await client.post(
+                "/v1/completions", json={**payload, "model": "tiny"}
+            )
+            base_text = (await r.json())["choices"][0]["text"]
+            r = await client.post("/v1/completions", json=payload)
+            assert (await r.json())["choices"][0]["text"] == base_text
+            assert tuned_text != base_text
+
+            # Unknown model: 404 with the OpenAI error shape, before
+            # any engine work.
+            r = await client.post(
+                "/v1/completions", json={**payload, "model": "stranger"}
+            )
+            assert r.status == 404
+            err = (await r.json())["error"]
+            assert err["code"] == "model_not_found"
+
+            # /loadz mirrors the roster + counters.
+            r = await client.get("/loadz")
+            snap = await r.json()
+            assert snap["adapters"] == ["my-tuned"]
+            assert "adapter_hits" in snap
+
+    try:
+        asyncio.run(go())
+    finally:
+        eng.stop()
+
+
+def test_loadreport_header_roundtrip_with_adapters():
+    from substratus_tpu.gateway.loadreport import LoadReport
+
+    rep = LoadReport(
+        queue_depth=3, active_slots=2, max_slots=8, kv_free_frac=0.5,
+        adapters=("t1", "t2"),
+    )
+    back = LoadReport.from_header(rep.to_header())
+    assert back.adapters == ("t1", "t2")
+    assert back.queue_depth == 3 and back.max_slots == 8
+    # Hostile ids never corrupt the k=v framing.
+    evil = LoadReport(adapters=("ok", "sp ace", "se;mi", "eq=l"))
+    back = LoadReport.from_header(evil.to_header())
+    assert back.adapters == ("ok",)
+    # Reports without the ad key (old replicas) parse as before.
+    assert LoadReport.from_header("q=1 a=0 m=8 kvf=1.000").adapters == ()
+
+
+def test_balancer_adapter_affinity():
+    """Repeated same-adapter traffic lands on the replica already
+    holding the adapter (ISSUE 6 acceptance); unknown adapters fall
+    back to plain p2c; a full resident replica is never forced."""
+    from substratus_tpu.gateway.balancer import Balancer
+    from substratus_tpu.gateway.loadreport import LoadReport
+
+    urls = [f"http://r{i}" for i in range(4)]
+    bal = Balancer(urls, max_inflight=2, seed=7)
+    resident = bal.replicas["http://r2"]
+    bal.observe_report(resident, LoadReport(adapters=("t1",)))
+    # Even as the busiest replica (short of its window), affinity wins.
+    bal.acquire(resident)
+    for _ in range(32):
+        assert bal.pick(adapter="t1") is resident
+    # No resident replica anywhere: plain p2c spread.
+    picked = {bal.pick(adapter="t9").url for _ in range(64)}
+    assert len(picked) > 1
+    # Resident replica at its in-flight window: fall back, don't queue.
+    bal.acquire(resident)
+    assert bal.pick(adapter="t1") is not resident
+    # ...and excluded (hedge) replicas stay excluded.
+    bal.release(resident)
+    assert bal.pick(adapter="t1", exclude=("http://r2",)) is not resident
+
+
+def test_chat_cli_passes_model_field():
+    """sub chat --model: the OpenAI model field rides the request body
+    CLI -> server (the gateway relays bodies verbatim)."""
+    import http.server
+    import json as _json
+    import threading as _threading
+
+    from substratus_tpu.cli.chat import stream_chat
+
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            seen.update(_json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            chunk = _json.dumps(
+                {"choices": [{"delta": {"content": "hi"}}]}
+            )
+            self.wfile.write(
+                f"data: {chunk}\n\ndata: [DONE]\n\n".encode()
+            )
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    t = _threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        msgs = [{"role": "user", "content": "hello"}]
+        out = list(stream_chat(url, msgs, model="my-tuned"))
+        assert out == ["hi"]
+        assert seen["model"] == "my-tuned"
+        seen.clear()
+        list(stream_chat(url, msgs))  # no --model: field stays absent
+        assert "model" not in seen
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_chat_cli_registers_model_flag():
+    from substratus_tpu.cli.root import build_parser
+
+    args = build_parser().parse_args(
+        ["chat", "--url", "http://x", "--adapter", "t1"]
+    )
+    assert args.model == "t1"
+    args = build_parser().parse_args(
+        ["chat", "--url", "http://x", "--model", "t2"]
+    )
+    assert args.model == "t2"

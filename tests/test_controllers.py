@@ -278,6 +278,110 @@ def test_server_single_replica_has_no_gateway(env):
     assert svc["spec"]["selector"] == {"substratus.ai/object": "server-solo"}
 
 
+def test_server_shared_base_collapses_to_one_deployment(env):
+    """Multi-tenant adapter serving (docs/serving.md): two Server CRs
+    whose params.baseModel name the same base Model collapse onto ONE
+    backing deployment — the base mounted at /content/model, each
+    tenant's adapter artifact at /content/adapters/<tenant> — while
+    every tenant keeps its own front Service name. No per-tenant
+    `{name}-server` Deployments exist."""
+    from substratus_tpu.kube.client import NotFound
+
+    client, cloud, sci, mgr = env
+    for name in ("base", "tuner-a", "tuner-b"):
+        client.create(_model(name=name))
+    mgr.run_until_idle()
+    for name in ("base", "tuner-a", "tuner-b"):
+        client.mark_job_complete("default", f"{name}-modeller")
+
+    for srv, model in (("srv-a", "tuner-a"), ("srv-b", "tuner-b")):
+        client.create(
+            {
+                "apiVersion": "substratus.ai/v1",
+                "kind": "Server",
+                "metadata": {"name": srv, "namespace": "default"},
+                "spec": {
+                    "image": "img:3",
+                    "model": {"name": model},
+                    "params": {"baseModel": "base"},
+                },
+            }
+        )
+    mgr.run_until_idle()
+
+    dep = client.get("Deployment", "default", "base-shared-server")
+    tmpl = dep["spec"]["template"]
+    mounts = {
+        m["mountPath"]
+        for m in tmpl["spec"]["containers"][0]["volumeMounts"]
+    }
+    assert "/content/model" in mounts
+    assert "/content/adapters/srv-a" in mounts
+    assert "/content/adapters/srv-b" in mounts
+    # The adapter mounts point at the ADAPTER subdir of each finetune's
+    # artifacts (train/main.py writes {out}/adapter for LoRA runs).
+    adapter_subs = {
+        m["mountPath"]: m["subPath"]
+        for m in tmpl["spec"]["containers"][0]["volumeMounts"]
+        if m["mountPath"].startswith("/content/adapters/")
+    }
+    assert all(sub == "artifacts/adapter" for sub in adapter_subs.values())
+
+    # One deployment, not one per tenant.
+    for tenant_dep in ("srv-a-server", "srv-b-server"):
+        try:
+            client.get("Deployment", "default", tenant_dep)
+            raise AssertionError(f"{tenant_dep} should not exist")
+        except NotFound:
+            pass
+
+    # Both tenants keep their own front Service, selecting shared pods.
+    shared_sel = {"substratus.ai/object": "shared-server-base"}
+    for svc_name in ("srv-a-server", "srv-b-server"):
+        svc = client.get("Service", "default", svc_name)
+        assert svc["spec"]["selector"] == shared_sel
+    assert shared_sel.items() <= tmpl["metadata"]["labels"].items()
+
+    # Ready flows from the ONE deployment to BOTH tenants.
+    assert client.get("Server", "default", "srv-a")["status"]["ready"] is False
+    client.mark_deployment_ready("default", "base-shared-server")
+    mgr.run_until_idle()
+    for srv in ("srv-a", "srv-b"):
+        assert client.get("Server", "default", srv)["status"]["ready"] is True
+
+
+def test_server_shared_base_gates_on_base_model(env):
+    """A tenant whose base Model is missing parks with ModelNotFound and
+    deploys nothing."""
+    from substratus_tpu.kube.client import NotFound
+
+    client, cloud, sci, mgr = env
+    client.create(_model(name="adap"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "adap-modeller")
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "orphan", "namespace": "default"},
+            "spec": {
+                "image": "img:3",
+                "model": {"name": "adap"},
+                "params": {"baseModel": "nope"},
+            },
+        }
+    )
+    mgr.run_until_idle()
+    srv = client.get("Server", "default", "orphan")
+    conds = {c["type"]: c for c in srv["status"]["conditions"]}
+    assert conds["Serving"]["reason"] == "ModelNotFound"
+    try:
+        client.get("Deployment", "default", "nope-shared-server")
+        raise AssertionError("shared deployment should not exist")
+    except NotFound:
+        pass
+
+
 def test_server_multihost_tpu_serving_gang(env):
     """A Server asking for a multi-host slice (the examples/llama2-70b
     v5e-16 shape) must become a lockstep serving gang — JobSet +

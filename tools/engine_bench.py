@@ -68,19 +68,21 @@ def build_prompts(a, cfg):
     ]
 
 
-def run_load(engine, prompts, max_tokens):
+def run_load(engine, prompts, max_tokens, adapter_names=None):
     """Run all prompts concurrently; returns (gen_tokens, wall_s,
     ttft_s list) with TTFT measured client-side (submit -> first token),
-    the same boundary an HTTP caller would see."""
+    the same boundary an HTTP caller would see. With `adapter_names`,
+    requests round-robin across the tenant adapters — the mixed-adapter
+    packed batch the --adapters leg measures."""
     from substratus_tpu.serve.engine import Request
 
     done = []
     ttfts = []
     lock = threading.Lock()
 
-    def run_one(p):
+    def run_one(p, adapter=None):
         req = engine.submit(Request(list(p), max_tokens=max_tokens,
-                                    temperature=0.0))
+                                    temperature=0.0, adapter=adapter))
         t0 = time.perf_counter()
         n = 0
         first = None
@@ -97,7 +99,17 @@ def run_load(engine, prompts, max_tokens):
                 ttfts.append(first)
 
     t0 = time.perf_counter()
-    threads = [threading.Thread(target=run_one, args=(p,)) for p in prompts]
+    threads = [
+        threading.Thread(
+            target=run_one,
+            args=(
+                p,
+                adapter_names[i % len(adapter_names)]
+                if adapter_names else None,
+            ),
+        )
+        for i, p in enumerate(prompts)
+    ]
     for t in threads:
         t.start()
     for t in threads:
@@ -143,6 +155,37 @@ def make_engine(a, mesh=None, sync=None):
         )(jax.random.key(0))
     jax.tree.leaves(params)[0].block_until_ready()
 
+    adapters = None
+    if getattr(a, "adapters", 0):
+        # N random tenants packed into one engine (serve/adapters.py):
+        # real nonzero A/B pairs so the per-row gather + rank-r einsums
+        # cost what production adapters cost.
+        import numpy as np
+
+        from substratus_tpu.serve.adapters import AdapterStore
+        from substratus_tpu.train.lora import init_lora
+
+        rank = 8
+        adapters = AdapterStore(
+            cfg, capacity=a.adapters, rank=rank, dtype=cfg.dtype
+        )
+        for i in range(a.adapters):
+            tree = init_lora(
+                cfg, jax.random.key(100 + i), rank=rank, alpha=2 * rank,
+                dtype=cfg.dtype,
+            )
+            for name in tree:
+                tree[name]["b"] = (
+                    jax.random.normal(
+                        jax.random.key(200 + i), tree[name]["b"].shape
+                    ) * 0.01
+                )
+            adapters.install(
+                f"tenant-{i}",
+                jax.tree.map(np.asarray, tree),
+                scale=2.0,
+            )
+
     ec = EngineConfig(
         max_batch=a.batch,
         max_seq_len=min(a.max_seq_len, cfg.max_seq_len),
@@ -153,7 +196,7 @@ def make_engine(a, mesh=None, sync=None):
         eos_token_id=257 if a.config == "tiny" else 2,
         step_floor_s=a.step_floor_ms / 1e3,
     )
-    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync)
+    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync, adapters=adapters)
     engine.start()
     return cfg, engine
 
@@ -195,7 +238,13 @@ def measure(a, mesh=None, sync=None) -> dict:
                 admission["broadcast_bytes"] = nbytes
                 admission["broadcast_ms"] = round(secs * 1e3, 3)
 
-    gen_tokens, wall_s, ttfts = run_load(engine, prompts, a.max_tokens)
+    adapter_names = (
+        [f"tenant-{i}" for i in range(a.adapters)]
+        if getattr(a, "adapters", 0) else None
+    )
+    gen_tokens, wall_s, ttfts = run_load(
+        engine, prompts, a.max_tokens, adapter_names
+    )
     out = {
         "gen_tokens": gen_tokens,
         "wall_s": round(wall_s, 3),
@@ -592,6 +641,13 @@ def parse_args(argv=None):
              "mesh shape; prints the combined comparison JSON",
     )
     ap.add_argument(
+        "--adapters", type=int, default=0,
+        help="pack N random LoRA tenants into one engine and run the "
+             "mixed-adapter load round-robin vs an identical base-only "
+             "engine on the same shape; prints the packed-vs-base JSON "
+             "(substratus_tpu/serve/adapters.py, docs/serving.md)",
+    )
+    ap.add_argument(
         "--gateway", type=int, default=0,
         help="N replica HTTP servers behind the routing gateway vs one "
              "direct replica; prints the routed-vs-direct JSON "
@@ -663,6 +719,16 @@ def parse_args(argv=None):
             a.max_tokens = min(a.max_tokens, 48)
             if not a.step_floor_ms:
                 a.step_floor_ms = 15.0
+        elif a.adapters:
+            # The adapter-packing smoke (ISSUE 6 acceptance): a mixed
+            # 4-tenant batch vs base-only on the same shape, decode
+            # long enough to dominate prefill, simulated device step so
+            # the ratio measures the packed program's per-iteration
+            # cost (the gather + rank-r einsums), not host core count.
+            a.requests = min(a.requests, 2 * a.batch)
+            a.max_tokens = min(a.max_tokens, 32)
+            if not a.step_floor_ms:
+                a.step_floor_ms = 15.0
         else:
             a.requests = min(a.requests, 6)
             a.max_tokens = min(a.max_tokens, 8)
@@ -709,6 +775,48 @@ def main() -> int:
 
     if a.gang_worker:
         return gang_worker(a)
+
+    if a.adapters:
+        # Packed mixed-adapter engine vs base-only engine, same shape,
+        # same process (ISSUE 6 acceptance: packed within 15% of base
+        # with the simulated device step).
+        import copy
+
+        packed = measure(a)
+        base_a = copy.copy(a)
+        base_a.adapters = 0
+        base = measure(base_a)
+        ttft_packed = packed["ttft_ms"].get("p50")
+        ttft_base = base["ttft_ms"].get("p50")
+        record = {
+            "metric": (
+                f"{a.config.replace('-', '_')}_adapter_packed_throughput"
+            ),
+            "value": packed["gen_tok_s"],
+            "unit": "gen_tokens/sec",
+            "adapters": a.adapters,
+            "base_value": base["gen_tok_s"],
+            "packed_vs_base": (
+                round(packed["gen_tok_s"] / base["gen_tok_s"], 3)
+                if base["gen_tok_s"] else None
+            ),
+            "ttft_p50_ms": ttft_packed,
+            "ttft_p50_ms_base": ttft_base,
+            "ttft_delta_ms": (
+                round(ttft_packed - ttft_base, 3)
+                if ttft_packed is not None and ttft_base is not None
+                else None
+            ),
+            "requests": a.requests,
+            "max_tokens": a.max_tokens,
+            "step_floor_ms": a.step_floor_ms,
+            "quantize": a.quantize,
+            "kv_layout": a.kv_layout,
+            "wall_s": packed["wall_s"],
+            "wall_s_base": base["wall_s"],
+        }
+        print(json.dumps(record))
+        return 0
 
     if a.gang:
         base = passthrough_args(a)

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench graft image install-manifests
+.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench prefix-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -96,6 +96,23 @@ gateway-bench:
 # validates the capture schema).
 adapter-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --adapters 4 \
+	  | $(PY) hack/bench_compare.py --validate -
+
+# Disaggregated prefill/decode capture (ISSUE 7 acceptance): a
+# 1-prefill + 1-decode pair over the real TCP KV handoff vs 2
+# monolithic engines on the same shape under a prompt-burst workload
+# with the simulated device step — burst-window p99 inter-token
+# latency must drop >=30% with aggregate tok/s within 10%
+# (docs/serving.md "Disaggregated prefill/decode").
+disagg-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --disagg \
+	  | $(PY) hack/bench_compare.py --validate -
+
+# Shared-prefix KV reuse capture (ROADMAP item 1 evidence): repeated
+# system-prompt workload, prefix registry on vs off — TTFT and
+# aggregate tok/s.
+prefix-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --prefix-reuse \
 	  | $(PY) hack/bench_compare.py --validate -
 
 # Bench JSON schema + >10% regression gate (hack/bench_compare.py):

@@ -39,6 +39,10 @@ DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     # the same unlocked-write scrutiny as the engine.
     "gateway/router.py",
     "gateway/balancer.py",
+    # The KV-handoff layer is the most thread-dense module in the tree
+    # (sender thread, per-channel readers, accept loop, the engine
+    # scheduler calling ship()) — its _lock discipline stays enforced.
+    "serve/disagg.py",
 )
 
 _BLOCKING = {

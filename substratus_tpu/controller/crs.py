@@ -402,6 +402,11 @@ class ServerReconciler(BaseReconciler):
         )
         if pod["_slice"]["num_hosts"] > 1:
             return self._reconcile_multihost(obj, pod)
+        disagg = ((obj.get("spec") or {}).get("params") or {}).get(
+            "disaggregated"
+        )
+        if disagg:
+            return self._reconcile_disaggregated(obj, pod, disagg)
         replicas = int((obj.get("spec") or {}).get("params", {}).get("replicas", 1))
         engine_selector = {"substratus.ai/object": f"server-{md['name']}"}
         deployment: Obj = {
@@ -475,6 +480,78 @@ class ServerReconciler(BaseReconciler):
         set_condition(
             obj, C.CONDITION_SERVING, ready,
             C.REASON_DEPLOYMENT_READY if ready else C.REASON_DEPLOYMENT_NOT_READY,
+        )
+        write_status(self.client, obj)
+        return Result()
+
+    def _reconcile_disaggregated(self, obj: Obj, pod, disagg) -> Result:
+        """Disaggregated prefill/decode serving (docs/serving.md,
+        serve/disagg.py): `params.disaggregated` — `true` for a 1+1
+        split, or `{"prefill": N, "decode": M}` — deploys two
+        phase-specialized tiers plus the routing gateway fronting the
+        PREFILL tier; the client-facing Service name stays
+        `{name}-server`, exactly like the replicated path."""
+        from substratus_tpu.controller.workloads import (
+            disagg_tier_selector,
+            disaggregated_server_workloads,
+            serving_gateway_workloads,
+        )
+
+        md = obj["metadata"]
+        ns = md["namespace"]
+        counts = disagg if isinstance(disagg, dict) else {}
+        n_prefill = max(1, int(counts.get("prefill", 1)))
+        n_decode = max(1, int(counts.get("decode", 1)))
+        front_name = f"{md['name']}-server"
+        tier_live = {}
+        for w in disaggregated_server_workloads(
+            obj, front_name, pod, n_prefill, n_decode
+        ):
+            live = reconcile_child(self.client, w)
+            if w["kind"] == "Deployment":
+                tier_live[w["metadata"]["name"]] = live
+        # The gateway routes admissions; its replica set is the PREFILL
+        # tier (role-aware pick would skip decode replicas anyway, but
+        # not discovering them avoids wasted /loadz polls).
+        gw_live = [
+            reconcile_child(self.client, w)
+            for w in serving_gateway_workloads(
+                obj, front_name,
+                (obj.get("spec") or {}).get("image"),
+                disagg_tier_selector(md["name"], "prefill"),
+            )
+        ]
+        gateway_ready = (
+            gw_live[-1].get("status", {}).get("readyReplicas") or 0
+        ) > 0
+        service: Obj = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": front_name,
+                "namespace": ns,
+                "ownerReferences": [owner_reference(obj)],
+            },
+            "spec": {
+                "selector": {
+                    "substratus.ai/object": f"server-gateway-{md['name']}"
+                },
+                "ports": [
+                    {"port": 8080, "targetPort": "http-gw", "name": "http"}
+                ],
+            },
+        }
+        reconcile_child(self.client, service)
+        tiers_ready = all(
+            (live.get("status", {}).get("readyReplicas") or 0) > 0
+            for live in tier_live.values()
+        ) and len(tier_live) == 2
+        ready = tiers_ready and gateway_ready
+        obj.setdefault("status", {})["ready"] = ready
+        set_condition(
+            obj, C.CONDITION_SERVING, ready,
+            C.REASON_DEPLOYMENT_READY if ready
+            else C.REASON_DEPLOYMENT_NOT_READY,
         )
         write_status(self.client, obj)
         return Result()

@@ -421,6 +421,106 @@ def serving_gateway_workloads(
     return [replicas_svc, deployment]
 
 
+KV_TRANSFER_PORT = 8500
+
+
+def disagg_tier_selector(obj_name: str, role: str) -> Dict[str, str]:
+    """Pod selector of one disaggregated serving tier."""
+    return {
+        "substratus.ai/object": f"server-{obj_name}",
+        "substratus.ai/serve-role": role,
+    }
+
+
+def decode_transfer_service_name(front_name: str) -> str:
+    """Headless Service exposing the decode tier's KV-transfer port —
+    the DNS name prefill workers resolve into their peer set."""
+    return f"{front_name}-decode-transfer"
+
+
+def disaggregated_server_workloads(
+    obj: Obj, front_name: str, pod: Dict[str, Any],
+    prefill_replicas: int, decode_replicas: int,
+) -> List[Obj]:
+    """Two phase-specialized tiers for one Server (docs/serving.md
+    "Disaggregated prefill/decode", serve/disagg.py): a prefill
+    Deployment that admits requests and ships KV pages, a decode
+    Deployment that continues them, and a headless Service exposing the
+    decode tier's transfer port. Both tiers run the SAME image/params —
+    the controller differentiates them purely through env
+    (SUBSTRATUS_SERVE_ROLE / SUBSTRATUS_DECODE_PEERS /
+    SUBSTRATUS_TRANSFER_PORT, read by serve.main), so one ConfigMap
+    serves both. The routing gateway fronts the prefill tier only
+    (decode replicas never take client admissions)."""
+    import copy
+
+    md = obj["metadata"]
+    ns = md["namespace"]
+    transfer_dns = (
+        f"{decode_transfer_service_name(front_name)}.{ns}.svc"
+        f":{KV_TRANSFER_PORT}"
+    )
+    out: List[Obj] = [{
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": decode_transfer_service_name(front_name),
+            "namespace": ns,
+            "ownerReferences": [owner_reference(obj)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": disagg_tier_selector(md["name"], "decode"),
+            "ports": [{
+                "port": KV_TRANSFER_PORT,
+                "targetPort": "kv-transfer",
+                "name": "kv-transfer",
+            }],
+        },
+    }]
+    for role, n in (
+        ("decode", decode_replicas), ("prefill", prefill_replicas)
+    ):
+        tier = copy.deepcopy(
+            {"metadata": pod["metadata"], "spec": pod["spec"]}
+        )
+        labels = disagg_tier_selector(md["name"], role)
+        tier["metadata"].setdefault("labels", {}).update(labels)
+        container = tier["spec"]["containers"][0]
+        env = container.setdefault("env", [])
+        env.append({"name": "SUBSTRATUS_SERVE_ROLE", "value": role})
+        if role == "decode":
+            env.append({
+                "name": "SUBSTRATUS_TRANSFER_PORT",
+                "value": str(KV_TRANSFER_PORT),
+            })
+            container.setdefault("ports", []).append(
+                {"containerPort": KV_TRANSFER_PORT, "name": "kv-transfer"}
+            )
+        else:
+            env.append({
+                "name": "SUBSTRATUS_DECODE_PEERS", "value": transfer_dns,
+            })
+        out.append({
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": f"{front_name}-{role}",
+                "namespace": ns,
+                "ownerReferences": [owner_reference(obj)],
+            },
+            "spec": {
+                "replicas": int(n),
+                "selector": {"matchLabels": dict(labels)},
+                "template": {
+                    "metadata": tier["metadata"],
+                    "spec": tier["spec"],
+                },
+            },
+        })
+    return out
+
+
 def shared_server_name(base_model_name: str) -> str:
     """Backing Deployment name for Servers that share one base Model
     (multi-tenant adapter serving, docs/serving.md)."""

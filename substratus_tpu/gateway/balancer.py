@@ -109,9 +109,17 @@ class Balancer:
         ]
 
     def pick(self, now: Optional[float] = None,
-             exclude: tuple = (), adapter: Optional[str] = None) -> Optional[Replica]:
+             exclude: tuple = (), adapter: Optional[str] = None,
+             role: Optional[str] = None) -> Optional[Replica]:
         """Power-of-two-choices among eligible replicas; None = shed.
         `exclude` carries the urls a hedged retry already failed on.
+
+        `role` is the serving phase the request needs (disaggregated
+        serving, serve/disagg.py): completions route to the prefill
+        pool, so `role="prefill"` keeps replicas reporting that role
+        (or "both" — monolithic deployments are unaffected) and always
+        drops decode-role replicas, which only accept KV migrations
+        from the prefill tier, never client admissions.
 
         `adapter` is the request's LoRA adapter id (the OpenAI `model`
         field): replicas whose last load report lists it resident are
@@ -122,6 +130,13 @@ class Balancer:
         balancing; with no resident replica it falls back to the full
         candidate set (the chosen replica hot-loads on admission)."""
         cands = self.eligible(now, exclude)
+        if role:
+            # A decode replica 503s client completions anyway; dropping
+            # it here saves the wasted attempt (and the hedge budget).
+            cands = [
+                r for r in cands
+                if r.report.role in (role, "both")
+            ]
         if not cands:
             return None
         if adapter:

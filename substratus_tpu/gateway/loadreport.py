@@ -43,6 +43,13 @@ class LoadReport:
     # adapter-affinity scoring prefers replicas that already hold a
     # request's adapter (balancer.py).
     adapters: Tuple[str, ...] = ()
+    # Disaggregated serving (serve/disagg.py): which phase this replica
+    # runs ("both" = monolithic, "prefill", "decode") and its transfer
+    # backlog (handoffs waiting to ship / migrations waiting to board).
+    # Admissions route to the prefill pool; decode replicas never take
+    # client completions directly (balancer.pick(role=...)).
+    role: str = "both"
+    transfer_queue: int = 0
     # Stamped by the RECEIVER (gateway clock): reports age out rather
     # than mislead — a 30 s old "idle" beats routing storms.
     ts: float = field(default_factory=time.monotonic)
@@ -54,13 +61,25 @@ class LoadReport:
         replicas about to preempt."""
         occupancy = self.active_slots / max(1, self.max_slots)
         kv_pressure = 1.0 - self.kv_free_frac
-        return 2.0 * self.queue_depth + occupancy + 0.5 * kv_pressure
+        # Transfer backlog counts like queued work at half weight: a
+        # handoff waiting to ship blocks a client stream, but drains
+        # faster than a whole batch residency.
+        return (
+            2.0 * self.queue_depth + occupancy + 0.5 * kv_pressure
+            + 0.5 * self.transfer_queue
+        )
 
     def to_header(self) -> str:
         out = (
             f"q={self.queue_depth} a={self.active_slots} "
             f"m={self.max_slots} kvf={self.kv_free_frac:.3f}"
         )
+        if self.role != "both":
+            # One char on the wire; absent = "both" (monolithic replicas
+            # and pre-disaggregation gateways stay byte-identical).
+            out += f" r={self.role[0]}"
+        if self.transfer_queue:
+            out += f" tq={self.transfer_queue}"
         if self.adapters:
             # `;`-joined: header values stay comma/space-free so the
             # k=v split survives; ids with either separator are dropped
@@ -80,12 +99,16 @@ class LoadReport:
         report)."""
         kv = {}
         adapters: Tuple[str, ...] = ()
+        role = "both"
         for part in value.replace(",", " ").split():
             if "=" not in part:
                 continue
             k, _, v = part.partition("=")
             if k == "ad":
                 adapters = tuple(a for a in v.split(";") if a)
+                continue
+            if k == "r":
+                role = {"p": "prefill", "d": "decode"}.get(v, "both")
                 continue
             try:
                 kv[k] = float(v)
@@ -97,6 +120,8 @@ class LoadReport:
             max_slots=max(1, int(kv.get("m", 1))),
             kv_free_frac=min(1.0, max(0.0, kv.get("kvf", 1.0))),
             adapters=adapters,
+            role=role,
+            transfer_queue=max(0, int(kv.get("tq", 0))),
         )
 
     @classmethod
@@ -112,4 +137,6 @@ class LoadReport:
             adapters=tuple(
                 str(a) for a in (snap.get("adapters") or ())
             ),
+            role=str(snap.get("role", "both") or "both"),
+            transfer_queue=max(0, int(snap.get("transfer_queue_depth", 0))),
         )

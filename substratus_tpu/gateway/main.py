@@ -131,6 +131,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--burst", type=float, default=None)
     ap.add_argument(
+        "--adapter-rate", type=float,
+        default=float(os.environ.get("GATEWAY_ADAPTER_RATE", 0)),
+        help="per-adapter (OpenAI model field) requests/second quota "
+             "(0 = off) — multi-tenant fairness on shared engines",
+    )
+    ap.add_argument("--adapter-burst", type=float, default=None)
+    ap.add_argument(
         "--default-timeout", type=float,
         default=float(os.environ.get("GATEWAY_DEFAULT_TIMEOUT", 0)),
         help="deadline stamped on requests that carry none (seconds; "
@@ -161,6 +168,8 @@ def main(argv=None) -> int:
         max_inflight=args.max_inflight,
         rate=args.rate,
         burst=args.burst,
+        adapter_rate=args.adapter_rate,
+        adapter_burst=args.adapter_burst,
         default_timeout=args.default_timeout,
         poll_interval=args.poll_interval,
     ))

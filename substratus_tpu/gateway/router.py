@@ -75,7 +75,8 @@ METRICS.describe(
 METRICS.describe(
     "substratus_gateway_sheds_total",
     "Requests shed instead of queued, by reason "
-    "(ratelimit, deadline, no_replica, saturated).", type="counter",
+    "(ratelimit, adapter_quota, deadline, no_replica, saturated).",
+    type="counter",
 )
 METRICS.describe(
     "substratus_gateway_hedges_total",
@@ -126,6 +127,8 @@ class GatewayConfig:
         max_inflight: int = 32,  # per-replica in-flight window
         rate: float = 0.0,  # per-key requests/sec (0 = limiter off)
         burst: Optional[float] = None,
+        adapter_rate: float = 0.0,  # per-adapter requests/sec (0 = off)
+        adapter_burst: Optional[float] = None,
         default_timeout: float = 0.0,  # default deadline (0 = none)
         connect_timeout: float = 2.0,
         backoff_base: float = 0.5,
@@ -137,6 +140,8 @@ class GatewayConfig:
         self.max_inflight = max_inflight
         self.rate = rate
         self.burst = burst
+        self.adapter_rate = adapter_rate
+        self.adapter_burst = adapter_burst
         self.default_timeout = default_timeout
         self.connect_timeout = connect_timeout
         self.backoff_base = backoff_base
@@ -158,6 +163,12 @@ class Gateway:
             backoff_cap=self.cfg.backoff_cap, seed=seed,
         )
         self.limiter = KeyedLimiter(self.cfg.rate, self.cfg.burst)
+        # Per-adapter quotas (multi-tenant fairness, ISSUE 6 follow-up):
+        # keyed by the routed `model`/adapter id, so one tenant's burst
+        # exhausts its own budget, not its co-tenants' shared engine.
+        self.adapter_limiter = KeyedLimiter(
+            self.cfg.adapter_rate, self.cfg.adapter_burst
+        )
         self.session: Optional[aiohttp.ClientSession] = None
         self._poll_task: Optional[asyncio.Task] = None
 
@@ -311,12 +322,24 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         ok, retry_after = gw.limiter.allow(api_key_of(request.headers))
         if not ok:
             raise gw._shed("ratelimit", retry_after, status=429)
+        if adapter:
+            # Per-adapter quota (token bucket keyed by the routed
+            # `model` field): one tenant's burst drains its own budget
+            # instead of starving its co-tenants on the shared engine.
+            ok, retry_after = gw.adapter_limiter.allow(adapter)
+            if not ok:
+                raise gw._shed("adapter_quota", retry_after, status=429)
+        # Completions are admissions: in a disaggregated deployment
+        # they must land on the prefill pool (serve/disagg.py) — the
+        # decode tier only takes KV migrations. Monolithic replicas
+        # report role "both" and match as before.
         return await _route(request, body, streaming=streaming,
-                            adapter=adapter)
+                            adapter=adapter, role="prefill")
 
     async def _route(request: web.Request, body: bytes,
                      streaming: bool,
-                     adapter: Optional[str] = None) -> web.StreamResponse:
+                     adapter: Optional[str] = None,
+                     role: Optional[str] = None) -> web.StreamResponse:
         deadline = parse_deadline(
             request.headers, gw.cfg.default_timeout
         )
@@ -333,14 +356,15 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             if adapter:
                 span.set_attribute("adapter", adapter)
             resp = await _attempts(
-                request, body, streaming, deadline, span, adapter
+                request, body, streaming, deadline, span, adapter, role
             )
             span.set_attribute("http_status", resp.status)
             return resp
 
     async def _attempts(request: web.Request, body: bytes,
                         streaming: bool, deadline: Optional[float],
-                        span, adapter: Optional[str] = None
+                        span, adapter: Optional[str] = None,
+                        role: Optional[str] = None
                         ) -> web.StreamResponse:
         """The hedged-retry loop around single-replica attempts."""
         tried: tuple = ()
@@ -364,7 +388,7 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             return exc
 
         for attempt in range(1 + gw.cfg.max_hedges):
-            rep = gw.balancer.pick(exclude=tried, adapter=adapter)
+            rep = gw.balancer.pick(exclude=tried, adapter=adapter, role=role)
             if rep is None:
                 if shed_response is not None:
                     # Every other replica is down/full and this one said

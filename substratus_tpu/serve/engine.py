@@ -82,6 +82,21 @@ METRICS.describe(
     '{phase="decode"}).',
     type="gauge",
 )
+# True counters (monotonic, rate()-able) for prefix-cache effectiveness —
+# the scrape-time substratus_serve_<stat> gauges mirror the same numbers
+# but only when a server is attached; these increment at admission.
+METRICS.describe(
+    "substratus_serve_prefill_tokens_total",
+    "Prompt tokens actually prefilled through the model (prefix-cache "
+    "misses; the cold-work half of the reuse ratio).",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_serve_prefix_hit_tokens_total",
+    "Prompt tokens satisfied from shared prefix pages instead of "
+    "recompute (paged layout, serve/paged_kv.py).",
+    type="counter",
+)
 
 
 class EngineOverloaded(RuntimeError):
@@ -109,13 +124,22 @@ class EngineConfig:
     # queueing beyond this many waiters. None = unbounded (legacy
     # behavior; serve.main defaults it to 4x max_batch).
     max_queue: Optional[int] = None
-    # Bench/smoke knob: minimum wall time per decode iteration,
-    # simulating accelerator step latency on CPU hosts where the tiny
-    # model's math is instant (the control-plane analogue of
-    # multihost.TcpSync). With it, a CPU gateway bench measures what
-    # the routing tier controls — keeping N replicas concurrently
-    # busy — instead of the host's core count. 0 = off (production).
+    # Bench/smoke knob: minimum wall time per decode iteration AND per
+    # prefill chunk dispatch, simulating accelerator step latency on CPU
+    # hosts where the tiny model's math is instant (the control-plane
+    # analogue of multihost.TcpSync). With it, a CPU gateway bench
+    # measures what the routing tier controls — keeping N replicas
+    # concurrently busy — instead of the host's core count; the prefill
+    # floor makes prompt-vs-decode contention measurable (the effect
+    # disaggregation removes). 0 = off (production).
     step_floor_s: float = 0.0
+    # Disaggregated serving role (serve/disagg.py, ROADMAP item 3):
+    # "both" = the monolithic engine (default); "prefill" = run chunked
+    # prefill + first-token sampling, then export the request's KV pages
+    # to a decode engine (requires a HandoffManager and the paged
+    # layout); "decode" = accept migrated KV pages via submit_migration
+    # and continue decoding (external submit() is rejected).
+    role: str = "both"
     top_k: int = 0  # static top-k (0 = disabled)
     eos_token_id: int = 2
     # "model" keeps the cache in the model dtype; "int8" stores entries
@@ -220,6 +244,7 @@ class Engine:
         draft: Optional[tuple] = None,  # (draft_cfg, draft_params)
         sync=None,  # serve.multihost.StepSync for multi-host lockstep
         adapters=None,  # serve.adapters.AdapterStore for multi-tenant LoRA
+        handoff=None,  # serve.disagg.HandoffManager for role="prefill"
     ):
         """model: the model-family module (models.llama, models.opt, ...)
         implementing forward/init_cache/param_logical_axes/cache_logical_axes.
@@ -259,6 +284,15 @@ class Engine:
                 f"kv_cache_dtype {ec.kv_cache_dtype!r} invalid "
                 "(expected 'model' or 'int8')"
             )
+        if ec.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role {ec.role!r} invalid (both|prefill|decode)"
+            )
+        if ec.role != "both" and sync is not None:
+            raise ValueError(
+                "disaggregated roles are incompatible with lockstep sync "
+                "(a gang engine is one replica; split pools across gangs)"
+            )
         if ec.max_prefill_len < 1 or ec.max_batch < 1 or ec.max_seq_len < 2:
             raise ValueError(
                 f"invalid engine config: max_prefill_len={ec.max_prefill_len} "
@@ -291,6 +325,19 @@ class Engine:
                 f"kv_layout=paged unsupported for {model.__name__}"
             )
         self.paged = layout == "paged"
+        if ec.role != "both" and not self.paged:
+            # The handoff ships pool pages; the dense slot cache has no
+            # page-granular export.
+            raise ValueError(
+                f"role={ec.role!r} requires the paged kv layout"
+            )
+        self.handoff = handoff
+        if ec.role == "prefill":
+            if handoff is None:
+                raise ValueError(
+                    "role='prefill' needs a serve.disagg.HandoffManager"
+                )
+            handoff.bind_engine(self)
 
         self.mesh = mesh
         if mesh is not None:
@@ -396,6 +443,8 @@ class Engine:
             "spec_proposed": 0,
             "spec_accepted": 0,
             "adapter_requests": 0,
+            "handoffs": 0,
+            "migrations_in": 0,
         }
 
         # Speculative decoding state. The draft pool shares the target's
@@ -443,6 +492,12 @@ class Engine:
             self.draft_cache = draft_pool
 
         self.queue: "queue.Queue[Request]" = queue.Queue()
+        # Migrated-request admission (serve/disagg.py): the HandoffServer
+        # enqueues from its connection threads; only the scheduler thread
+        # consumes. Held-back migrations (pool dry / adapter pinned) wait
+        # in _resume_migrations, in front of fresh ones.
+        self._migrations: "queue.Queue" = queue.Queue()
+        self._resume_migrations: List = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
@@ -472,6 +527,8 @@ class Engine:
             self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
             self._insert_fn = self._build_insert()
             self._extract_slot, self._restore_slot = self._build_slot_io()
+        else:
+            self._export_fn, self._import_fn = self._build_page_io()
 
     # --- jitted device functions -----------------------------------------
 
@@ -613,6 +670,50 @@ class Engine:
 
         return extract, restore
 
+    def _build_page_io(self):
+        """Page-granular pool I/O for the disaggregated handoff
+        (serve/disagg.py): export gathers a request's pages out of the
+        pool, import scatters transferred pages into freshly allocated
+        ones. `ids` is bucket-padded by the caller (padding ids point at
+        the trash page, physical page 0) so each power-of-two page count
+        compiles once."""
+        from substratus_tpu.ops.quant import dequantize_kv, quantize_kv
+
+        @jax.jit
+        def export(cache, ids):
+            return {
+                key: self._replicated(jnp.take(cache[key], ids, axis=1))
+                for key in cache
+            }
+
+        @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+        def import_(convert, cache, ids, frag):
+            out = dict(cache)
+            if convert == "quantize":
+                # Model-dtype pages arriving at an int8 pool: the same
+                # per-vector quantization the pool's own writes use.
+                for name in ("k", "v"):
+                    q, s = quantize_kv(frag[name])
+                    out[name] = cache[name].at[:, ids].set(q)
+                    out[f"{name}_scale"] = (
+                        cache[f"{name}_scale"].at[:, ids].set(s)
+                    )
+            elif convert == "dequantize":
+                for name in ("k", "v"):
+                    vals = dequantize_kv(
+                        frag[name], frag[f"{name}_scale"],
+                        cache[name].dtype,
+                    )
+                    out[name] = cache[name].at[:, ids].set(vals)
+            else:
+                for name in cache:
+                    out[name] = cache[name].at[:, ids].set(
+                        frag[name].astype(cache[name].dtype)
+                    )
+            return out
+
+        return export, import_
+
     def _build_insert(self):
         @partial(jax.jit, donate_argnums=(0,))
         def insert(cache, kv, slot):
@@ -692,6 +793,11 @@ class Engine:
             raise RuntimeError(
                 "follower engine: requests arrive via the leader broadcast"
             )
+        if self.ec.role == "decode":
+            raise RuntimeError(
+                "decode-role engine: requests arrive as KV migrations "
+                "from the prefill tier (serve/disagg.py)"
+            )
         if req.adapter is not None:
             from substratus_tpu.serve.adapters import UnknownAdapter
 
@@ -724,6 +830,39 @@ class Engine:
             req.finish_reason = "error"
             req.out.put(None)
         return req
+
+    def resubmit(self, req: Request) -> None:
+        """Re-board a request that already passed admission control once
+        (handoff requeue after a decode-worker loss, serve/disagg.py):
+        bypasses the max_queue bound — shedding an accepted request
+        halfway through its stream would convert a worker failure into
+        a client-visible 429."""
+        if self.error is not None:
+            req.finish_reason = "error"
+            req.out.put(None)
+            return
+        self.queue.put(req)
+        if self.error is not None:  # same submit() race: never strand it
+            req.finish_reason = "error"
+            req.out.put(None)
+
+    def submit_migration(self, mig) -> None:
+        """Board a migrated request (serve.disagg.Migration): KV pages
+        already computed by a prefill engine — admission installs them
+        without recompute. Called from HandoffServer connection threads;
+        the scheduler thread is the only consumer."""
+        if self.ec.role != "decode":
+            raise RuntimeError(
+                f"role={self.ec.role!r} engine cannot accept migrations"
+            )
+        if self.error is not None:
+            mig.req.finish_reason = "error"
+            mig.req.out.put(None)
+            return
+        self._migrations.put(mig)
+        if self.error is not None:
+            mig.req.finish_reason = "error"
+            mig.req.out.put(None)
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -823,7 +962,7 @@ class Engine:
         Admission is capped per scheduler iteration so a burst of arrivals
         can't starve in-flight decodes: each loop admits a few prefills,
         then every active slot advances a token."""
-        admitted = 0
+        admitted = self._admit_migrations()
         # No in-flight decodes -> nothing to starve: fill freely (decode
         # steps cost the same at any occupancy, so boarding everyone first
         # is strictly better for TTFT).
@@ -887,6 +1026,105 @@ class Engine:
             self.stats["max_active"], int(self.active.sum())  # sublint: allow[hostsync]: self.active is a host numpy mirror, no device read
         )
         return admitted
+
+    def _admit_migrations(self) -> int:
+        """Board migrated requests (decode role, serve/disagg.py): pages
+        arrive precomputed, so admission is an allocation + one scatter —
+        no model forward, no starvation concern, hence no per-iteration
+        cap beyond free slots. Pool-dry migrations hold at the front
+        (decoding slots will free pages); they are never preempted FOR —
+        a migration is cheaper to delay than a decode is to evict."""
+        admitted = 0
+        while (
+            (self._resume_migrations or not self._migrations.empty())
+            and not self.active.all()
+        ):
+            if self._resume_migrations:
+                mig = self._resume_migrations.pop(0)
+            else:
+                try:
+                    mig = self._migrations.get_nowait()
+                except queue.Empty:
+                    break
+            verdict = self._acquire_adapter(mig.req)
+            if verdict == "dead":
+                continue
+            if verdict == "wait":
+                self._resume_migrations.insert(0, mig)
+                break
+            if not self._install_migration(mig):
+                self._release_adapter_pin(mig.req)
+                self._resume_migrations.insert(0, mig)
+                break
+            admitted += 1
+        return admitted
+
+    def _install_migration(self, mig) -> bool:
+        """Allocate pages for one migration and scatter its transferred
+        KV in; False = pool dry (hold the migration, nothing leaked)."""
+        req = mig.req
+        n = mig.pages["k"].shape[1]
+        owned = self._try_alloc(n)
+        if owned is None:
+            return False
+        slot = int(np.flatnonzero(~self.active)[0])
+        self.slot_pages.assign(slot, [], owned)
+        row = np.zeros((self.max_pages,), np.int32)
+        row[:n] = owned
+        self.block_table[slot] = row
+        cap = _bucket(n, 1)
+        ids = np.zeros((cap,), np.int32)  # padding scatters to trash page 0
+        ids[:n] = owned
+        frag = {}
+        for name, a in mig.pages.items():
+            if cap != n:
+                pad = np.zeros((a.shape[0], cap - n) + a.shape[2:], a.dtype)
+                a = np.concatenate([a, pad], axis=1)
+            frag[name] = a
+        self.cache = self._import_fn(mig.convert, self.cache, ids, frag)
+        self.stats["migrations_in"] += 1
+
+        true_len = mig.true_len
+        self.slot_req[slot] = req
+        self.slot_generated[slot] = 0
+        self.slot_adapter[slot] = req.adapter_slot
+        self.adapter_ids[slot] = req.adapter_slot
+        self.active[slot] = True
+        self.host_positions[slot] = true_len
+        self.slot_tokens[slot] = []
+        self._admit_counter += 1
+        self.slot_admit_seq[slot] = self._admit_counter
+        self.tokens[slot] = mig.first_token
+        self.positions[slot] = true_len
+        self.temps[slot] = req.temperature
+        self.top_ps[slot] = req.top_p
+        # The first token was sampled on the prefill engine but never
+        # delivered — this emit is its delivery (the whole stream flows
+        # from the decode tier).
+        self._emit(slot, mig.first_token)
+        return True
+
+    def _handoff_request(self, req: Request, slot: int, first_id: int,
+                         true_len: int) -> None:
+        """Prefill role: export the admitted slot's pages, free the slot,
+        and hand (pages + first token + sampling state) to the transfer
+        layer. The slot never activates — the decode tier owns the rest
+        of the request's lifecycle."""
+        pages = list(self.slot_pages.pages[slot])
+        n = len(pages)
+        cap = _bucket(n, 1)
+        ids = np.zeros((cap,), np.int32)
+        ids[:n] = pages
+        frag = self._export_fn(self.cache, ids)
+        host = {
+            key: np.asarray(v)[:, :n]  # sublint: allow[hostsync]: the handoff IS a device->host transfer — one gather read per migrated request
+            for key, v in frag.items()
+        }
+        self.slot_pages.release(slot, self.alloc)
+        self.block_table[slot] = 0
+        self._release_adapter_pin(req)
+        self.stats["handoffs"] += 1
+        self.handoff.ship(req, host, true_len, first_id)
 
     def _acquire_adapter(self, req: Request) -> str:
         """Resolve + pin the request's adapter before prefill. Returns
@@ -957,6 +1195,7 @@ class Engine:
         else:
             last_logits = self._chunked_prefill(prompt, slot, lora, ids1)
         self.stats["prefill_tokens"] += true_len
+        METRICS.inc("substratus_serve_prefill_tokens_total", by=true_len)
         self._finalize_admit(req, slot, last_logits, true_len)
         return True
 
@@ -1018,6 +1257,13 @@ class Engine:
         )
         self.stats["prefill_tokens"] += true_len - reuse
         self.stats["prefix_hit_tokens"] += reuse
+        METRICS.inc(
+            "substratus_serve_prefill_tokens_total", by=true_len - reuse
+        )
+        if reuse:
+            METRICS.inc(
+                "substratus_serve_prefix_hit_tokens_total", by=reuse
+            )
 
         if self.spec_draft:
             # Draft prefill also starts at `reuse`: the draft pool indexes
@@ -1044,6 +1290,7 @@ class Engine:
         chunk = self.ec.max_prefill_len
         offset, last_logits = start, None
         while offset < len(prompt):
+            t0 = time.perf_counter()
             padded, clen = _pad_to_bucket(
                 prompt[offset : offset + chunk], chunk
             )
@@ -1052,6 +1299,13 @@ class Engine:
                 lora=lora, adapter_ids=adapter_ids,
             )
             offset += clen
+            dt = time.perf_counter() - t0
+            if self.ec.step_floor_s > dt:
+                # Simulated device-step latency applies to prefill chunks
+                # too: on a real accelerator every chunk occupies the
+                # device, which is exactly the decode-stalling contention
+                # the disaggregated split removes (see EngineConfig).
+                time.sleep(self.ec.step_floor_s - dt)
         return last_logits, cache
 
     def _finalize_admit(self, req: Request, slot: int, last_logits,
@@ -1071,6 +1325,10 @@ class Engine:
             time.perf_counter() - t_sample,
             {"phase": "sample"},
         )
+
+        if self.ec.role == "prefill":
+            self._handoff_request(req, slot, first_id, true_len)
+            return
 
         self.slot_req[slot] = req
         self.slot_generated[slot] = 0
@@ -1488,6 +1746,13 @@ class Engine:
                     kill(req)
             for req in self._resume:
                 kill(req)
+            for mig in self._resume_migrations:
+                kill(mig.req)
+            while not self._migrations.empty():
+                try:
+                    kill(self._migrations.get_nowait().req)
+                except queue.Empty:
+                    break
             while not self.queue.empty():
                 try:
                     kill(self.queue.get_nowait())
@@ -1506,12 +1771,29 @@ class Engine:
             kv_free = self.alloc.free_pages / max(1, self.n_pages)
         else:
             kv_free = (self.ec.max_batch - active) / self.ec.max_batch
+        if self.ec.role == "prefill" and self.handoff is not None:
+            transfer_q = self.handoff.depth()
+        elif self.ec.role == "decode":
+            transfer_q = self._migrations.qsize() + len(
+                self._resume_migrations
+            )
+        else:
+            transfer_q = 0
         snap = {
             "queue_depth": self.queue.qsize() + len(self._resume),
             "active_slots": active,
             "max_slots": self.ec.max_batch,
             "kv_free_frac": round(kv_free, 4),
             "max_queue": self.ec.max_queue,
+            # Disaggregated serving (serve/disagg.py): which phase this
+            # replica runs, and how deep its transfer/migration backlog
+            # is — the gateway's role-aware routing reads both.
+            "role": self.ec.role,
+            "transfer_queue_depth": transfer_q,
+            # Prefix-cache effectiveness, mirrored for /loadz consumers
+            # (also on /metrics as the *_total counters).
+            "prefill_tokens": self.stats["prefill_tokens"],
+            "prefix_hit_tokens": self.stats["prefix_hit_tokens"],
         }
         if self.adapters is not None:
             # Resident adapter ids + hit/miss/evict counters: the

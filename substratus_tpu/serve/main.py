@@ -105,6 +105,24 @@ def main(argv=None) -> int:
         help="draft tokens proposed per verify pass (0 = off)",
     )
     ap.add_argument(
+        "--role", default=None, choices=["both", "prefill", "decode"],
+        help="disaggregated serving role (serve/disagg.py): prefill "
+             "workers hand KV pages to decode workers; default 'both' "
+             "(monolithic). Env SUBSTRATUS_SERVE_ROLE / params.json "
+             "'role' also set it (flag > env > params)",
+    )
+    ap.add_argument(
+        "--transfer-port", type=int, default=None,
+        help="KV-transfer listen port for role=decode (default 8500; "
+             "env SUBSTRATUS_TRANSFER_PORT / params 'transfer_port')",
+    )
+    ap.add_argument(
+        "--decode-peers", default=None,
+        help="comma-separated host:port transfer endpoints of the "
+             "decode tier, for role=prefill (env "
+             "SUBSTRATUS_DECODE_PEERS / params 'decode_peers')",
+    )
+    ap.add_argument(
         "--adapters-dir", default=None,
         help="directory of LoRA adapter artifacts served multi-tenant "
              "(one subdir per adapter id; default /content/adapters "
@@ -145,7 +163,8 @@ def main(argv=None) -> int:
             "max_prefill_len", "kv_cache_dtype", "kv_layout", "attn_impl",
             "chunk_attn_impl", "decode_attn_impl", "q4_impl", "tensor",
             "sequence", "replicas", "draft_model", "spec_k", "max_queue",
-            "drain_grace", "adapters", "baseModel",
+            "drain_grace", "adapters", "baseModel", "disaggregated",
+            "role", "transfer_port", "decode_peers",
         ),
         "serve.main",
     )
@@ -421,11 +440,56 @@ def main(argv=None) -> int:
                 flush=True,
             )
 
+    # Disaggregated prefill/decode serving (serve/disagg.py, ROADMAP
+    # item 3). Per-tier values arrive as env vars (the controller stamps
+    # SUBSTRATUS_SERVE_ROLE per Deployment — both tiers share one params
+    # ConfigMap) with flag > env > params precedence.
+    role = (
+        args.role
+        or os.environ.get("SUBSTRATUS_SERVE_ROLE")
+        or str(params_json.get("role", "both"))
+    )
+    if role not in ("both", "prefill", "decode"):
+        raise SystemExit(f"role {role!r} invalid (both|prefill|decode)")
+    handoff = None
+    if role != "both" and sync is not None:
+        raise SystemExit("disaggregated roles don't combine with a "
+                         "multi-host lockstep gang")
+    if role == "prefill":
+        from substratus_tpu.serve.disagg import HandoffManager, PoolSpec
+
+        raw_peers = (
+            args.decode_peers
+            or os.environ.get("SUBSTRATUS_DECODE_PEERS")
+            or ",".join(params_json.get("decode_peers", []) or [])
+        )
+        peers = [p.strip() for p in raw_peers.split(",") if p.strip()]
+        if not peers:
+            raise SystemExit("role=prefill needs --decode-peers")
+        ec.role = "prefill"
+        handoff = HandoffManager(peers, PoolSpec.from_engine_config(cfg, ec))
+        print(f"prefill role: decode peers {peers}", flush=True)
+    elif role == "decode":
+        ec.role = "decode"
+
     engine = Engine(
         cfg, params, ec, mesh=mesh, model=family, draft=draft, sync=sync,
-        adapters=adapters,
+        adapters=adapters, handoff=handoff,
     )
     engine.start()
+
+    if role == "decode":
+        from substratus_tpu.serve.disagg import (
+            DEFAULT_TRANSFER_PORT, HandoffServer,
+        )
+
+        transfer_port = int(
+            args.transfer_port
+            or os.environ.get("SUBSTRATUS_TRANSFER_PORT")
+            or params_json.get("transfer_port", DEFAULT_TRANSFER_PORT)
+        )
+        transfer = HandoffServer(engine, host=args.host, port=transfer_port)
+        print(f"decode role: KV transfer on :{transfer.port}", flush=True)
     if sync is not None and not sync.leader:
         # Follower: no HTTP. Mirror the leader's scheduler until it
         # broadcasts stop (or the process is torn down with the gang).

@@ -754,6 +754,20 @@ def build_app(state: ServerState) -> web.Application:
         server stops taking NEW requests (503 so the caller retries on
         a live replica), and an already-expired deadline is shed as
         504 — decoding for a client that gave up wastes a slot."""
+        if state.engine.ec.role == "decode":
+            # Disaggregated decode tier (serve/disagg.py): requests
+            # arrive as KV migrations over the transfer port, never as
+            # client completions. A role-aware gateway never routes
+            # here; a misdirected client gets an honest shed.
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps({"error": {
+                    "message": "decode-role replica: completions are "
+                               "admitted by the prefill tier",
+                    "type": "wrong_role",
+                }}),
+                content_type="application/json",
+                headers={"Retry-After": "1"},
+            )
         if state.draining:
             raise web.HTTPServiceUnavailable(
                 text=json.dumps({"error": {

@@ -249,6 +249,79 @@ def test_server_single_host_replicas_fanout(env):
     assert client.get("Server", "default", "srv2")["status"]["ready"] is False
 
 
+def test_server_disaggregated_two_tiers(env):
+    """`params.disaggregated: {prefill: 2, decode: 1}` (ISSUE 7) deploys
+    phase-specialized tiers: a prefill Deployment (decode peers via env),
+    a decode Deployment exposing the KV-transfer port, a headless
+    transfer Service over the decode pods, the gateway fronting the
+    PREFILL tier, and the stable front Service at the gateway. Ready
+    requires all three deployments."""
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "dsrv", "namespace": "default"},
+            "spec": {
+                "image": "img:3",
+                "model": {"name": "base"},
+                "params": {"disaggregated": {"prefill": 2, "decode": 1}},
+            },
+        }
+    )
+    mgr.run_until_idle()
+
+    pre = client.get("Deployment", "default", "dsrv-server-prefill")
+    dec = client.get("Deployment", "default", "dsrv-server-decode")
+    assert pre["spec"]["replicas"] == 2
+    assert dec["spec"]["replicas"] == 1
+
+    def env_of(dep):
+        return {
+            e["name"]: e.get("value")
+            for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+
+    assert env_of(pre)["SUBSTRATUS_SERVE_ROLE"] == "prefill"
+    assert "dsrv-server-decode-transfer" in env_of(pre)[
+        "SUBSTRATUS_DECODE_PEERS"
+    ]
+    assert env_of(dec)["SUBSTRATUS_SERVE_ROLE"] == "decode"
+    dec_c = dec["spec"]["template"]["spec"]["containers"][0]
+    assert {"containerPort": 8500, "name": "kv-transfer"} in dec_c["ports"]
+
+    # Headless transfer Service selects the DECODE pods only.
+    tsvc = client.get("Service", "default", "dsrv-server-decode-transfer")
+    assert tsvc["spec"]["clusterIP"] == "None"
+    dec_labels = dec["spec"]["template"]["metadata"]["labels"]
+    assert tsvc["spec"]["selector"].items() <= dec_labels.items()
+    pre_labels = pre["spec"]["template"]["metadata"]["labels"]
+    assert not tsvc["spec"]["selector"].items() <= pre_labels.items()
+
+    # The gateway discovers the PREFILL tier (admissions never land on
+    # decode replicas); the front Service points at the gateway.
+    gw_replicas_svc = client.get("Service", "default", "dsrv-server-replicas")
+    assert gw_replicas_svc["spec"]["selector"].items() <= pre_labels.items()
+    assert not (
+        gw_replicas_svc["spec"]["selector"].items() <= dec_labels.items()
+    )
+    svc = client.get("Service", "default", "dsrv-server")
+    assert svc["spec"]["ports"][0]["targetPort"] == "http-gw"
+
+    # Ready needs prefill + decode + gateway.
+    assert client.get("Server", "default", "dsrv")["status"]["ready"] is False
+    client.mark_deployment_ready("default", "dsrv-server-prefill")
+    client.mark_deployment_ready("default", "dsrv-server-decode")
+    mgr.run_until_idle()
+    assert client.get("Server", "default", "dsrv")["status"]["ready"] is False
+    client.mark_deployment_ready("default", "dsrv-server-gateway")
+    mgr.run_until_idle()
+    assert client.get("Server", "default", "dsrv")["status"]["ready"] is True
+
+
 def test_server_single_replica_has_no_gateway(env):
     """replicas: 1 (the default) keeps the direct shape: no gateway
     Deployment, front Service selects the engine pods directly."""

@@ -149,6 +149,47 @@ def test_leader_crash_broadcasts_stop(tmp_path):
     assert follower["error"] is None
 
 
+def test_two_process_gang_mixed_tenant_adapters(tmp_path):
+    """Multi-tenant adapter serving under a real 2-process lockstep gang
+    (PR 6 wired adapter ids through the event broadcast — the 'ad='
+    field — but never ran it on a gang): a mixed-tenant batch (base +
+    two LoRA tenants decoding concurrently) must be greedy token-exact
+    vs the single-process engine with the same store, and the follower
+    must mirror every per-row adapter gather without error."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from multihost_serve_worker import build_adapter_store
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    store = build_adapter_store(cfg, 2)
+    ec = EngineConfig(max_batch=4, max_seq_len=64, eos_token_id=257)
+    engine = Engine(cfg, params, ec, adapters=store)
+    engine.start()
+    try:
+        expected = [
+            engine.generate(p, max_tokens=6, temperature=0.0, adapter=ad)
+            for p, ad in (
+                ([256, 5, 6, 7], None),
+                ([256, 10, 20, 30], "t0"),
+                ([256, 10, 20, 30], "t1"),
+            )
+        ]
+    finally:
+        engine.stop()
+    # The two tenants must actually diverge (else parity is vacuous).
+    assert expected[1] != expected[2], expected
+
+    results = _run_gang(tmp_path, extra=("--adapters", "2"))
+    leader = next(r for r in results if r["leader"])
+    follower = next(r for r in results if not r["leader"])
+    assert leader["outs"] == expected, (leader["outs"], expected)
+    assert leader["stats"]["adapter_requests"] == 2
+    assert follower["stopped"] is True
+    assert follower["error"] is None
+
+
 def test_two_process_gang_draft_model_speculative(tmp_path):
     """DRAFT-MODEL speculation under lockstep (the propose scan is a
     device computation whose proposals every process reads back — the

@@ -117,10 +117,13 @@ def run_load(engine, prompts, max_tokens, adapter_names=None):
     return sum(done), time.perf_counter() - t0, ttfts
 
 
-def make_engine(a, mesh=None, sync=None):
+def make_engine(a, mesh=None, sync=None, role="both", handoff=None,
+                max_batch=None, max_prefill_len=None, prefix_cache=True):
     """Config + random params + Engine, honoring the CLI knobs (shared by
     the single-process path and every gang worker — 'same config' is a
-    code path, not a convention)."""
+    code path, not a convention). role/handoff build the disaggregated
+    split (--disagg leg); max_batch/max_prefill_len/prefix_cache
+    override the derived values for legs that need a specific shape."""
     import jax
 
     from bench import random_quantized_params
@@ -187,16 +190,19 @@ def make_engine(a, mesh=None, sync=None):
             )
 
     ec = EngineConfig(
-        max_batch=a.batch,
+        max_batch=max_batch or a.batch,
         max_seq_len=min(a.max_seq_len, cfg.max_seq_len),
-        max_prefill_len=min(256, a.max_seq_len),
+        max_prefill_len=max_prefill_len or min(256, a.max_seq_len),
         kv_cache_dtype="model" if a.config == "tiny" else a.kv_dtype,
         kv_layout=a.kv_layout,
         spec_k=a.spec_k,
         eos_token_id=257 if a.config == "tiny" else 2,
         step_floor_s=a.step_floor_ms / 1e3,
+        role=role,
+        prefix_cache=prefix_cache,
     )
-    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync, adapters=adapters)
+    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync, adapters=adapters,
+                    handoff=handoff)
     engine.start()
     return cfg, engine
 
@@ -606,6 +612,240 @@ def run_gateway_leg(a, base_args) -> dict:
     }
 
 
+def _timestamped_load(engines, prompts, max_tokens):
+    """Run prompts round-robin across `engines`, recording a wall-clock
+    timestamp per received token. Returns per-request dicts
+    {first, ts: [t0, t1, ...], n} (ts includes the first token)."""
+    from substratus_tpu.serve.engine import Request
+
+    # Mutated in place so the caller can watch progress live (the
+    # burst must land while the ongoing decodes are mid-flight).
+    records = [{"ts": [], "n": 0} for _ in prompts]
+
+    def run_one(i, p):
+        eng = engines[i % len(engines)]
+        req = eng.submit(
+            Request(list(p), max_tokens=max_tokens, temperature=0.0)
+        )
+        rec = records[i]
+        while True:
+            tok = req.out.get(timeout=600)
+            if tok is None:
+                break
+            rec["ts"].append(time.perf_counter())
+        rec["n"] = len(rec["ts"])
+
+    threads = [
+        threading.Thread(target=run_one, args=(i, p))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    return threads, records
+
+
+def _burst_drive(engines, a):
+    """The prompt-burst workload (disagg acceptance): ongoing decodes
+    start first; once they flow, a burst of long prompts lands. Returns
+    (p99 inter-token ms of the ongoing decodes DURING the burst window,
+    aggregate gen tok/s, total tokens, wall_s)."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    vocab = 250
+    n_ongoing = a.disagg_ongoing
+    ongoing_prompts = [
+        rng.integers(10, vocab, 16).tolist() for _ in range(n_ongoing)
+    ]
+    burst_prompts = [
+        rng.integers(10, vocab, a.disagg_burst_prompt).tolist()
+        for _ in range(a.disagg_burst)
+    ]
+
+    t0 = time.perf_counter()
+    threads, ongoing = _timestamped_load(
+        engines, ongoing_prompts, a.disagg_ongoing_tokens
+    )
+    # Wait until every ongoing request is decoding (has >= 2 tokens
+    # flowing) before firing the burst, so the burst hits steady decode.
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        live = [r for r in ongoing if len(r["ts"]) >= 2]
+        if len(live) == len(ongoing):
+            break
+        time.sleep(0.01)
+    burst_t0 = time.perf_counter()
+    bthreads, burst = _timestamped_load(engines, burst_prompts, 8)
+    for t in bthreads:
+        t.join()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    # The contention window: burst submission until the last burst
+    # request got its first token (i.e. every prefill completed).
+    burst_t1 = max(
+        (r["ts"][0] for r in burst if r and r["ts"]), default=burst_t0
+    )
+    gaps = []
+    for r in ongoing:
+        ts = r["ts"]
+        for prev, cur in zip(ts, ts[1:]):
+            if burst_t0 <= cur <= burst_t1:
+                gaps.append(cur - prev)
+    total = sum(r["n"] for r in ongoing) + sum(r["n"] for r in burst)
+    p99 = _percentiles_ms(gaps).get("p99")
+    return p99, round(total / wall, 1), total, round(wall, 3)
+
+
+def run_disagg_leg(a) -> dict:
+    """Disaggregated pair vs monolithic pair (ISSUE 7 acceptance): one
+    prefill + one decode engine joined by the real TCP KV-handoff
+    transport, against two monolithic engines — same model instances,
+    same total decode slots, same simulated device step — under the
+    prompt-burst workload. The number that matters is p99 inter-token
+    latency of the ONGOING decodes while the burst prefills: monolithic
+    engines stall their decode batch for every prefill chunk; the
+    decode tier never prefills."""
+    from substratus_tpu.serve.disagg import (
+        HandoffManager,
+        HandoffServer,
+        PoolSpec,
+    )
+
+    decode_slots = 2 * a.batch  # == the monolithic pair's total
+    chunk = a.disagg_chunk
+
+    # Disaggregated pair: all client traffic enters the prefill engine.
+    _, dec = make_engine(
+        a, role="decode", max_batch=decode_slots, max_prefill_len=chunk
+    )
+    srv = HandoffServer(dec, host="127.0.0.1")
+    mgr = HandoffManager(
+        [f"127.0.0.1:{srv.port}"],
+        PoolSpec.from_engine(dec),
+    )
+    _, pre = make_engine(
+        a, role="prefill", handoff=mgr, max_batch=decode_slots,
+        max_prefill_len=chunk,
+    )
+    pre.generate([10] * 8, max_tokens=2)  # warm executables off-clock
+    d_p99, d_toks, d_total, d_wall = _burst_drive([pre], a)
+    handoffs = pre.stats["handoffs"]
+    pre.stop()
+    dec.stop()
+    srv.close()
+    mgr.close()
+
+    # Monolithic pair: the same load round-robined across two engines.
+    monos = []
+    for _ in range(2):
+        _, eng = make_engine(a, max_prefill_len=chunk)
+        eng.generate([10] * 8, max_tokens=2)
+        monos.append(eng)
+    m_p99, m_toks, m_total, m_wall = _burst_drive(monos, a)
+    for eng in monos:
+        eng.stop()
+
+    return {
+        "metric": f"{a.config.replace('-', '_')}_disagg_burst_p99_inter_token",
+        "value": d_p99,
+        "unit": "ms",
+        "mono_value": m_p99,
+        "p99_vs_mono": (
+            round(d_p99 / m_p99, 3) if d_p99 and m_p99 else None
+        ),
+        "gen_tok_s": d_toks,
+        "mono_gen_tok_s": m_toks,
+        "tok_s_vs_mono": round(d_toks / m_toks, 3) if m_toks else None,
+        "gen_tokens": d_total,
+        "mono_gen_tokens": m_total,
+        "wall_s": d_wall,
+        "mono_wall_s": m_wall,
+        "handoffs": handoffs,
+        "ongoing": a.disagg_ongoing,
+        "burst": a.disagg_burst,
+        "burst_prompt_tokens": a.disagg_burst_prompt,
+        "step_floor_ms": a.step_floor_ms,
+        "decode_slots": decode_slots,
+    }
+
+
+def run_prefix_reuse_leg(a) -> dict:
+    """Shared-prefix reuse vs cold prefill (ROADMAP item 1 evidence):
+    the same repeated-system-prompt workload against an engine with the
+    prefix registry on and one with it off — TTFT is where reuse shows
+    (chunks skipped are device steps not taken), aggregate tok/s must
+    not regress."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    vocab = 250
+    chunk = a.prefix_chunk
+    shared = rng.integers(10, vocab, a.prefix_len).tolist()
+    prompts = [
+        shared + rng.integers(10, vocab, 8).tolist()
+        for _ in range(a.requests)
+    ]
+
+    def drive(prefix_cache: bool):
+        _, eng = make_engine(
+            a, max_prefill_len=chunk, prefix_cache=prefix_cache
+        )
+        # Warm every chunk-prefill shape off-clock with the full shared
+        # prompt — this also registers the prefix on the reuse engine,
+        # so the measurement is steady-state on both sides.
+        eng.generate(list(prompts[0]), max_tokens=2)
+        from substratus_tpu.serve.engine import Request
+
+        ttfts, total = [], 0
+        t0 = time.perf_counter()
+        # Sequential: TTFT measures prefill cost, not queueing noise —
+        # and lets the first request register the prefix for the rest.
+        for p in prompts:
+            req = eng.submit(
+                Request(list(p), max_tokens=a.max_tokens, temperature=0.0)
+            )
+            t1 = time.perf_counter()
+            first = None
+            while True:
+                tok = req.out.get(timeout=600)
+                if tok is None:
+                    break
+                if first is None:
+                    first = time.perf_counter() - t1
+                total += 1
+            ttfts.append(first)
+        wall = time.perf_counter() - t0
+        stats = dict(eng.stats)
+        eng.stop()
+        return ttfts, round(total / wall, 1), stats
+
+    reuse_ttfts, reuse_toks, reuse_stats = drive(True)
+    cold_ttfts, cold_toks, _ = drive(False)
+    reuse_p50 = _percentiles_ms(reuse_ttfts).get("p50")
+    cold_p50 = _percentiles_ms(cold_ttfts).get("p50")
+    return {
+        "metric": f"{a.config.replace('-', '_')}_prefix_reuse_ttft",
+        "value": reuse_p50,
+        "unit": "ms",
+        "cold_value": cold_p50,
+        "reuse_vs_cold_ttft": (
+            round(reuse_p50 / cold_p50, 3)
+            if reuse_p50 and cold_p50 else None
+        ),
+        "gen_tok_s": reuse_toks,
+        "cold_gen_tok_s": cold_toks,
+        "tok_s_vs_cold": (
+            round(reuse_toks / cold_toks, 3) if cold_toks else None
+        ),
+        "prefix_hit_tokens": reuse_stats["prefix_hit_tokens"],
+        "prefill_tokens": reuse_stats["prefill_tokens"],
+        "requests": a.requests,
+        "prefix_tokens": a.prefix_len,
+        "step_floor_ms": a.step_floor_ms,
+    }
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="llama2-7b")
@@ -653,6 +893,33 @@ def parse_args(argv=None):
              "direct replica; prints the routed-vs-direct JSON "
              "(substratus_tpu/gateway, docs/serving.md)",
     )
+    ap.add_argument(
+        "--disagg", action="store_true",
+        help="disaggregated 1-prefill + 1-decode pair (real TCP KV "
+             "handoff, serve/disagg.py) vs 2 monolithic engines under a "
+             "prompt-burst workload; prints burst-window p99 inter-token "
+             "latency and aggregate tok/s for both (docs/serving.md)",
+    )
+    ap.add_argument("--disagg-ongoing", type=int, default=6,
+                    help="ongoing decode requests the burst disturbs")
+    ap.add_argument("--disagg-ongoing-tokens", type=int, default=96)
+    ap.add_argument("--disagg-burst", type=int, default=4,
+                    help="long prompts fired mid-decode")
+    ap.add_argument("--disagg-burst-prompt", type=int, default=160)
+    ap.add_argument("--disagg-chunk", type=int, default=32,
+                    help="prefill chunk length (each chunk pays the "
+                         "simulated device step)")
+    ap.add_argument(
+        "--prefix-reuse", action="store_true",
+        help="repeated-shared-prefix workload vs cold prefill on the "
+             "same shape: TTFT win + aggregate tok/s (ROADMAP item 1 "
+             "evidence; the radix/COW reuse lives in serve/engine.py "
+             "_admit_paged)",
+    )
+    ap.add_argument("--prefix-len", type=int, default=96,
+                    help="shared prefix length in tokens")
+    ap.add_argument("--prefix-chunk", type=int, default=32,
+                    help="prefill chunk length for the prefix leg")
     ap.add_argument(
         "--long-admission", type=int, default=0,
         help="extra leg: one prompt of this many tokens, its admission "
@@ -729,6 +996,24 @@ def parse_args(argv=None):
             a.max_tokens = min(a.max_tokens, 32)
             if not a.step_floor_ms:
                 a.step_floor_ms = 15.0
+        elif a.disagg:
+            # The disaggregation smoke (ISSUE 7 acceptance): burst
+            # prompts long enough for several prefill chunks (each
+            # paying the simulated device step — the decode-stalling
+            # contention a monolithic engine can't avoid), a context
+            # window that fits prompt+generation, and enough ongoing
+            # decodes to make the inter-token histogram meaningful.
+            a.max_seq_len = 256
+            if not a.step_floor_ms:
+                a.step_floor_ms = 15.0
+        elif a.prefix_reuse:
+            # The prefix-reuse smoke (ROADMAP item 1 evidence): a
+            # shared prefix spanning several prefill chunks, so a
+            # registry hit skips real (simulated) device steps.
+            a.max_tokens = min(a.max_tokens, 8)
+            a.requests = min(a.requests, 8)
+            if not a.step_floor_ms:
+                a.step_floor_ms = 15.0
         else:
             a.requests = min(a.requests, 6)
             a.max_tokens = min(a.max_tokens, 8)
@@ -775,6 +1060,14 @@ def main() -> int:
 
     if a.gang_worker:
         return gang_worker(a)
+
+    if a.disagg:
+        print(json.dumps(run_disagg_leg(a)))
+        return 0
+
+    if a.prefix_reuse:
+        print(json.dumps(run_prefix_reuse_leg(a)))
+        return 0
 
     if a.adapters:
         # Packed mixed-adapter engine vs base-only engine, same shape,

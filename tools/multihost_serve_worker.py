@@ -16,6 +16,36 @@ import json
 import os
 
 
+def build_adapter_store(cfg, n: int):
+    """N deterministic LoRA tenants (t0..t{n-1}) packed into a store —
+    every gang process computes the identical host tensors from fixed
+    keys, and the gang test's single-process reference imports THIS
+    helper so worker and reference can never drift."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from substratus_tpu.serve.adapters import AdapterStore
+    from substratus_tpu.train.lora import init_lora
+
+    rank = 4
+    store = AdapterStore(cfg, capacity=n, rank=rank, dtype=jnp.float32)
+    for i in range(n):
+        tree = init_lora(
+            cfg, jax.random.key(50 + i), rank=rank, alpha=8.0,
+            dtype=jnp.float32,
+        )
+        for j, name in enumerate(sorted(tree)):
+            tree[name]["b"] = (
+                jax.random.normal(
+                    jax.random.key(500 + i * 7 + j),
+                    tree[name]["b"].shape, jnp.float32,
+                ) * 0.05
+            )
+        store.install(f"t{i}", jax.tree.map(np.asarray, tree), scale=2.0)
+    return store
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pid", type=int, required=True)
@@ -38,6 +68,10 @@ def main() -> int:
     ap.add_argument("--draft", action="store_true",
                     help="draft-model speculation (1-layer draft of the "
                          "same config; requires --spec-k)")
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="serve N deterministic LoRA tenants and run a "
+                         "mixed-tenant batch through the lockstep gang "
+                         "(the 'ad=' event-broadcast field under test)")
     args = ap.parse_args()
     if args.draft and not args.spec_k:
         ap.error("--draft requires --spec-k")
@@ -81,8 +115,12 @@ def main() -> int:
     if args.draft:
         draft_cfg = cfg.replace(n_layers=1)
         draft = (draft_cfg, llama.init_params(draft_cfg, jax.random.key(9)))
+    adapters = (
+        build_adapter_store(cfg, args.adapters) if args.adapters else None
+    )
     sync = StepSync()
-    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync, draft=draft)
+    engine = Engine(cfg, params, ec, mesh=mesh, sync=sync, draft=draft,
+                    adapters=adapters)
     engine.start()
 
     result = {"pid": args.pid, "leader": sync.leader}
@@ -109,6 +147,32 @@ def main() -> int:
             error=repr(engine.error) if engine.error else None,
         )
         engine._thread.join(timeout=60)
+    elif sync.leader and args.adapters:
+        # Mixed-tenant CONCURRENT batch: base + one row per tenant share
+        # one decode batch, adapter ids riding the event broadcast
+        # ("ad=") so every process gathers the same per-row adapters.
+        plan = [
+            ([256, 5, 6, 7], None),
+            ([256, 10, 20, 30], "t0"),
+            ([256, 10, 20, 30], "t1"),
+        ]
+        reqs = [
+            engine.submit(Request(list(p), max_tokens=6, temperature=0.0,
+                                  adapter=ad))
+            for p, ad in plan
+        ]
+        outs = []
+        for req in reqs:
+            got = []
+            while True:
+                tok = req.out.get(timeout=120)
+                if tok is None:
+                    break
+                got.append(tok)
+            outs.append(got)
+        result["outs"] = outs
+        result["stats"] = dict(engine.stats)
+        engine.stop()
     elif sync.leader:
         outs = []
         # Two sequential greedy generations + one sampled (deterministic:

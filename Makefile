@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench prefix-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench prefix-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -12,12 +12,23 @@ test:
 # shard (PartitionSpec axes vs the parallel/mesh.py registry), hostsync
 # (host-device syncs reachable from the engine decode loop / trainer
 # step), concurrency (cross-thread writes, thread lifecycle, blocking in
-# async), broad-except — plus the wrapped metrics/trace runtime lints.
-# Exits nonzero on any unsuppressed finding; suppressions require
-# reasons (docs/development.md#static-analysis-sublint). Also writes a
-# SARIF artifact for CI upload.
+# async), broad-except, lockorder (interprocedural lock cycles /
+# blocking-while-locked), lifecycle (alloc-free, pin-unpin,
+# shutdown-before-close), protodrift (wire-format producer/consumer key
+# agreement + endianness) — plus the wrapped metrics/trace runtime
+# lints. Exits nonzero on any unsuppressed finding; suppressions require
+# reasons (docs/development.md#static-analysis-sublint). Diffs against
+# the committed sublint.sarif baseline (stable fingerprints: only NEW
+# findings fail; the suppression count ratchets against it) and then
+# regenerates it as the CI artifact.
 lint:
-	$(PY) hack/sublint.py --sarif sublint.sarif
+	$(PY) hack/sublint.py --baseline sublint.sarif --sarif sublint.sarif
+
+# AST families only — no runtime deps, no subprocesses; fast enough for
+# a pre-commit hook and runs on a box with nothing but python installed.
+lint-fast:
+	$(PY) hack/sublint.py --checks \
+	  shard,hostsync,concurrency,broad-except,lockorder,lifecycle,protodrift
 
 # Aliases into the unified driver: one check family each. `make
 # trace-lint FILES=path.jsonl` still lints a real span export directly.

@@ -2,17 +2,27 @@
 """Whole-repo static analysis driver (make lint).
 
 Runs the AST check families from substratus_tpu/analysis/ — shard,
-hostsync, concurrency, broad-except — over the whole package, plus the
-two runtime lints (metrics, trace) as wrapped subprocess checks. Exits
-nonzero on any unsuppressed finding. See
-docs/development.md#static-analysis-sublint for the check catalog and
-the suppression syntax (`# sublint: allow[family]: reason`).
+hostsync, concurrency, broad-except, lockorder, lifecycle, protodrift —
+over the whole package, plus the two runtime lints (metrics, trace) as
+wrapped subprocess checks. Exits nonzero on any unsuppressed finding.
+See docs/development.md#static-analysis-sublint for the check catalog
+and the suppression syntax (`# sublint: allow[family]: reason`).
 
     python hack/sublint.py                      # everything, text output
     python hack/sublint.py --checks shard,hostsync
     python hack/sublint.py --format sarif       # SARIF to stdout
     python hack/sublint.py --sarif out.sarif    # text + SARIF artifact
+    python hack/sublint.py --baseline old.sarif # fail only on NEW findings
     python hack/sublint.py --list               # check catalog
+
+Baseline mode (`--baseline`, CI): findings carry stable fingerprints
+(check + path + digit-masked message + occurrence index, immune to
+unrelated line shifts); a finding whose fingerprint appears unsuppressed
+in the baseline SARIF is reported but does not fail the run, so a
+long-lived branch only breaks on findings IT introduced. The baseline
+also ratchets the suppression inventory: the run fails when the
+in-source `allow[]` count exceeds the baseline's, so suppressions
+cannot accrete silently (override ceiling with --max-suppressions).
 
 The AST families never import the code under analysis (and this driver
 never executes the substratus_tpu package __init__), so `--checks`
@@ -94,6 +104,17 @@ def main(argv=None) -> int:
     ap.add_argument("--json", dest="json_out", help="also write JSON here")
     ap.add_argument("--root", default=REPO_ROOT, help="repo root to lint")
     ap.add_argument(
+        "--baseline",
+        help="SARIF file of known findings: fail only on findings whose "
+        "fingerprint is absent from it, and ratchet the suppression "
+        "count against its inventory",
+    )
+    ap.add_argument(
+        "--max-suppressions", type=int, default=None,
+        help="explicit suppression-count ceiling (overrides the "
+        "baseline-derived ratchet)",
+    )
+    ap.add_argument(
         "--list", action="store_true", help="print the check catalog"
     )
     args = ap.parse_args(argv)
@@ -117,6 +138,21 @@ def main(argv=None) -> int:
     if unknown:
         print(f"sublint: unknown checks {unknown}", file=sys.stderr)
         return 2
+
+    # Read the baseline BEFORE any output file is written: `make lint`
+    # diffs against the committed sublint.sarif and then overwrites it.
+    base_fps, base_supp = None, None
+    if args.baseline and os.path.exists(args.baseline):
+        try:
+            base_fps, base_supp = analysis.baseline_fingerprints(
+                args.baseline
+            )
+        except (OSError, ValueError, KeyError) as e:
+            print(
+                f"sublint: unreadable baseline {args.baseline}: {e}",
+                file=sys.stderr,
+            )
+            return 2
 
     files = analysis.load_files(
         args.root, analysis.discover(args.root)
@@ -145,14 +181,44 @@ def main(argv=None) -> int:
         with open(args.json_out, "w") as f:
             f.write(analysis.render_json(findings))
 
-    if active:
+    n_supp = sum(1 for f in findings if f.suppressed)
+    failing = active
+    if base_fps is not None:
+        fps = analysis.assign_fingerprints(findings)
+        failing = [f for f in active if fps[id(f)] not in base_fps]
+        known = len(active) - len(failing)
+        if known:
+            print(
+                f"sublint: {known} pre-existing finding(s) ignored via "
+                f"baseline {args.baseline}"
+            )
+    ceiling = args.max_suppressions
+    if ceiling is None and base_supp is not None:
+        ceiling = base_supp
+    if ceiling is not None and n_supp > ceiling:
         print(
-            f"sublint: {len(active)} unsuppressed finding(s) across "
-            f"{len({f.path for f in active})} file(s)",
+            f"sublint: suppression ratchet: {n_supp} in-source "
+            f"suppressions exceed the ceiling of {ceiling} "
+            "(baseline-derived); remove one or consciously raise the "
+            "ceiling by regenerating the baseline SARIF",
             file=sys.stderr,
         )
         return 1
-    n_supp = sum(1 for f in findings if f.suppressed)
+
+    if failing:
+        tag = "new " if base_fps is not None else ""
+        print(
+            f"sublint: {len(failing)} {tag}unsuppressed finding(s) across "
+            f"{len({f.path for f in failing})} file(s)",
+            file=sys.stderr,
+        )
+        if base_fps is not None:  # text mode already listed everything
+            for f in failing:
+                print(
+                    f"  NEW {f.location()}: [{f.check}] {f.message}",
+                    file=sys.stderr,
+                )
+        return 1
     print(
         f"sublint: ok ({len(files)} files, "
         f"{len(ast_checks)} AST checks, {n_supp} reasoned suppressions)"

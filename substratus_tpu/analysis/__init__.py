@@ -10,6 +10,15 @@ Check families (the names are the suppression keys):
   concurrency   unlocked cross-thread attribute writes, threads without
                 daemon/join, blocking calls in async handlers
   broad-except  except:/except Exception handlers that swallow
+  lockorder     interprocedural lock-acquisition graph over the serving
+                stack: lock-order cycles, blocking calls while holding
+                a lock, bare acquire() without finally-guarded release
+  lifecycle     paired-call resource discipline: paged-KV alloc/free,
+                adapter-slot pin/unpin, exception-path leaks, and the
+                shutdown(SHUT_RDWR)-before-close() socket contract
+  protodrift    producer/consumer key agreement on the hand-rolled wire
+                formats (load header, disagg frames, PoolSpec hello,
+                gang events) + explicit-byte-order struct pairing
 
 Plus two meta families that are never suppressible: "suppression"
 (malformed/unused allow[] comments) and "parse" (unparseable files).
@@ -27,6 +36,8 @@ from substratus_tpu.analysis.core import (
     Finding,
     SourceFile,
     apply_suppressions,
+    assign_fingerprints,
+    baseline_fingerprints,
     discover,
     load_files,
     parse_suppressions,
@@ -36,6 +47,9 @@ from substratus_tpu.analysis.core import (
     run_checks,
 )
 from substratus_tpu.analysis.hostsync import HostSyncCheck
+from substratus_tpu.analysis.lifecycle import LifecycleCheck
+from substratus_tpu.analysis.lockorder import LockOrderCheck
+from substratus_tpu.analysis.protodrift import ProtoDriftCheck
 from substratus_tpu.analysis.shardlint import ShardCheck
 
 AST_CHECKS = {
@@ -43,6 +57,9 @@ AST_CHECKS = {
     "hostsync": HostSyncCheck,
     "concurrency": ConcurrencyCheck,
     "broad-except": BroadExceptCheck,
+    "lockorder": LockOrderCheck,
+    "lifecycle": LifecycleCheck,
+    "protodrift": ProtoDriftCheck,
 }
 
 __all__ = [
@@ -52,9 +69,14 @@ __all__ = [
     "ConcurrencyCheck",
     "Finding",
     "HostSyncCheck",
+    "LifecycleCheck",
+    "LockOrderCheck",
+    "ProtoDriftCheck",
     "ShardCheck",
     "SourceFile",
     "apply_suppressions",
+    "assign_fingerprints",
+    "baseline_fingerprints",
     "discover",
     "load_files",
     "parse_suppressions",

@@ -18,13 +18,14 @@ suppression inventory stays honest.
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import json
 import os
 import re
 import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 SUPPRESS_RE = re.compile(
     r"#\s*sublint:\s*allow\[([A-Za-z0-9_\-, ]+)\]\s*(?::\s*(\S.*))?"
@@ -214,6 +215,80 @@ def run_checks(
     )
 
 
+# --- stable finding fingerprints (baseline diff, CI) ----------------------
+
+_DIGITS_RE = re.compile(r"\d+")
+
+
+def _normalized_message(f: Finding) -> str:
+    """Message with every number masked: line numbers embedded in
+    concurrency/lockorder messages (call-site lists) must not churn the
+    fingerprint when unrelated lines shift."""
+    return _DIGITS_RE.sub("#", f.message)
+
+
+def assign_fingerprints(findings: Sequence[Finding]) -> Dict[int, str]:
+    """id(finding) -> stable fingerprint. The fingerprint commits to
+    (check, path, digit-masked message, occurrence index among findings
+    sharing that key, ordered by location) — NOT to the line number, so
+    a finding survives unrelated edits above it, while two identical
+    findings in one file stay distinct."""
+    by_key: Dict[Tuple[str, str, str], List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(
+            (f.check, f.path, _normalized_message(f)), []
+        ).append(f)
+    out: Dict[int, str] = {}
+    for (check, path, norm), group in by_key.items():
+        group.sort(key=lambda f: (f.line, f.col))
+        for idx, f in enumerate(group):
+            h = hashlib.sha1(
+                f"{check}|{path}|{norm}|{idx}".encode()
+            ).hexdigest()[:20]
+            out[id(f)] = h
+    return out
+
+
+def baseline_fingerprints(sarif_path: str) -> Tuple[Set[str], int]:
+    """(active-finding fingerprints, suppressed count) from a previously
+    published SARIF file — the `--baseline` input. Only UNSUPPRESSED
+    results enter the fingerprint set: a finding whose in-source
+    suppression is deleted must read as NEW, not as baseline-known.
+    Results written before the fingerprint era (no partialFingerprints)
+    are reconstructed from ruleId + uri + digit-masked message with the
+    same occurrence indexing, so an old baseline still diffs correctly."""
+    with open(sarif_path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    fps: Set[str] = set()
+    n_suppressed = 0
+    legacy: Dict[Tuple[str, str, str], int] = {}
+    for run in doc.get("runs", ()):
+        for res in run.get("results", ()):
+            if res.get("suppressions"):
+                n_suppressed += 1
+                continue
+            fp = (res.get("partialFingerprints") or {}).get("sublint/v1")
+            if fp:
+                fps.add(fp)
+                continue
+            loc = (res.get("locations") or [{}])[0].get(
+                "physicalLocation", {}
+            )
+            uri = loc.get("artifactLocation", {}).get("uri", "")
+            norm = _DIGITS_RE.sub(
+                "#", res.get("message", {}).get("text", "")
+            )
+            key = (str(res.get("ruleId", "")), uri, norm)
+            idx = legacy.get(key, 0)
+            legacy[key] = idx + 1
+            fps.add(
+                hashlib.sha1(
+                    f"{key[0]}|{key[1]}|{key[2]}|{idx}".encode()
+                ).hexdigest()[:20]
+            )
+    return fps, n_suppressed
+
+
 # --- small AST helpers shared by the check families ----------------------
 
 
@@ -281,12 +356,14 @@ def render_sarif(
     rule_ids = sorted(
         {f.check for f in findings} | {c.name for c in checks if c.name}
     )
+    fps = assign_fingerprints(findings)
     results = []
     for f in findings:
         result = {
             "ruleId": f.check,
             "level": "error",
             "message": {"text": f.message},
+            "partialFingerprints": {"sublint/v1": fps[id(f)]},
             "locations": [
                 {
                     "physicalLocation": {

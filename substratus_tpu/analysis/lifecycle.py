@@ -1,0 +1,327 @@
+"""lifecycle-lint: paired-call discipline for leak-prone resources.
+
+Three resource contracts whose leak is a production incident, not a
+style nit (each was pinned by convention/tests in PRs 5–7; this family
+is their static gate):
+
+  * **paged-KV pages** (`serve/paged_kv.py` allocator): every module
+    that calls ``.alloc()`` on a pool/allocator must also free
+    (``decref``/``release``); an alloc whose result is discarded is a
+    guaranteed leak; an alloc held across a ``try`` whose handler
+    swallows-and-exits without freeing leaks on the exception path.
+  * **adapter-slot pins** (`serve/adapters.py` ``acquire``/``release``):
+    same balance rules for the pin refcounts that keep LRU eviction
+    from pulling weights out from under an active decode.
+  * **shutdown-before-close sockets** (the PR 7 disagg contract): in
+    the threaded socket modules, ``close()`` on a socket another thread
+    may be blocked ``recv()``/``accept()``-ing neither wakes that
+    thread nor reliably sends FIN — every such ``close()`` must be
+    preceded by ``shutdown(SHUT_RDWR)`` on the same receiver
+    (docs/serving.md "Failure semantics").
+
+The checks are deliberately per-function/per-module AST reasoning, not
+full dataflow: cross-function pin lifecycles (acquire at admission,
+release at slot teardown) are validated as module-level balance, while
+the two precise rules — discarded handle, exception-path leak — fire
+only on patterns that are leaks by construction.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
+
+
+@dataclass(frozen=True)
+class ResourcePair:
+    """One paired-call contract: calls whose dotted name ends with an
+    open suffix AND whose receiver identifier contains a hint must be
+    balanced by close-suffix calls in the same module."""
+
+    name: str
+    open_suffixes: Tuple[str, ...]
+    close_suffixes: Tuple[str, ...]
+    receiver_hints: Tuple[str, ...]  # substring match on the receiver id
+    modules: Tuple[str, ...]  # suffix match; where the contract applies
+
+
+DEFAULT_RESOURCES: Tuple[ResourcePair, ...] = (
+    ResourcePair(
+        name="kv-page",
+        open_suffixes=(".alloc",),
+        close_suffixes=(".decref", ".release", ".free"),
+        receiver_hints=("alloc", "pool"),
+        modules=("serve/engine.py",),
+    ),
+    ResourcePair(
+        name="adapter-pin",
+        open_suffixes=(".acquire",),
+        close_suffixes=(".release",),
+        receiver_hints=("adapter",),
+        modules=("serve/engine.py", "serve/server.py"),
+    ),
+)
+
+# Threaded socket modules where the shutdown-before-close contract is
+# load-bearing (another thread may be blocked on the same fd).
+DEFAULT_SOCKET_MODULES: Tuple[str, ...] = (
+    "serve/disagg.py",
+    "serve/multihost.py",
+    "gateway/testing.py",
+)
+
+_SOCKETISH = ("sock", "conn", "srv", "listener", "client_s")
+
+
+def _recv_ident(node: ast.AST) -> Optional[str]:
+    """Receiver identifier of an attribute call chain: `self.alloc.alloc`
+    -> "alloc", `pool.alloc` -> "pool", `c.close` -> "c"."""
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Attribute):
+            return base.attr
+        if isinstance(base, ast.Name):
+            return base.id
+    return None
+
+
+def _matches(pair: ResourcePair, node: ast.Call) -> bool:
+    name = call_name(node)
+    if not any(name.endswith(s) for s in pair.open_suffixes):
+        return False
+    ident = _recv_ident(node.func) or ""
+    return any(h in ident.lower() for h in pair.receiver_hints)
+
+
+def _is_close(pair: ResourcePair, node: ast.Call) -> bool:
+    name = call_name(node)
+    return any(name.endswith(s) for s in pair.close_suffixes)
+
+
+def _socket_vars(fn: ast.AST) -> Set[str]:
+    """Local names that definitely hold sockets: assigned from
+    socket.socket(...)/create_connection(...)/X.accept(...), or bound by
+    iterating a connection-list-ish attribute (for c in self._conns)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = call_name(node.value)
+            if (
+                name.endswith("socket.socket")
+                or name == "socket"
+                or name.endswith("create_connection")
+                or name.endswith(".accept")
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+                    elif isinstance(t, ast.Tuple) and t.elts:
+                        first = t.elts[0]
+                        if isinstance(first, ast.Name):
+                            out.add(first.id)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            it_ident = None
+            if isinstance(node.iter, ast.Attribute):
+                it_ident = node.iter.attr
+            elif isinstance(node.iter, ast.Name):
+                it_ident = node.iter.id
+            # "chan"-named iterables are deliberately excluded: channel
+            # WRAPPERS own the shutdown-then-close sequence internally.
+            if it_ident and any(
+                k in it_ident.lower() for k in ("conn", "sock")
+            ):
+                if isinstance(node.target, ast.Name):
+                    out.add(node.target.id)
+    return out
+
+
+class LifecycleCheck(Check):
+    name = "lifecycle"
+    description = (
+        "paired-call resource discipline: paged-KV alloc/free balance, "
+        "adapter-slot pin/unpin balance, exception-path leaks, and the "
+        "shutdown(SHUT_RDWR)-before-close() socket contract in the "
+        "threaded transfer modules"
+    )
+
+    def __init__(
+        self,
+        resources: Sequence[ResourcePair] = DEFAULT_RESOURCES,
+        socket_modules: Sequence[str] = DEFAULT_SOCKET_MODULES,
+    ):
+        self.resources = tuple(resources)
+        self.socket_modules = tuple(socket_modules)
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, sf in sorted(files.items()):
+            if sf.tree is None:
+                continue
+            for pair in self.resources:
+                if any(rel.endswith(m) for m in pair.modules):
+                    out.extend(self._pair_findings(pair, sf))
+            if any(rel.endswith(m) for m in self.socket_modules):
+                out.extend(self._socket_findings(sf))
+        return out
+
+    # -- paired-call balance ------------------------------------------------
+
+    def _pair_findings(
+        self, pair: ResourcePair, sf: SourceFile
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        opens: List[ast.Call] = []
+        closes: List[ast.Call] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                if _matches(pair, node):
+                    opens.append(node)
+                elif _is_close(pair, node):
+                    closes.append(node)
+        if not opens:
+            return out
+        if not closes:
+            for node in opens:
+                out.append(
+                    Finding(
+                        check="lifecycle", path=sf.rel,
+                        line=node.lineno, col=node.col_offset + 1,
+                        message=(
+                            f"{pair.name}: {call_name(node)}() is called "
+                            f"here but this module never calls any of "
+                            f"{list(pair.close_suffixes)} — the resource "
+                            "can only leak"
+                        ),
+                    )
+                )
+            return out
+        # Discarded handle: an open call as a bare expression statement.
+        for stmt in ast.walk(sf.tree):
+            if (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and _matches(pair, stmt.value)
+            ):
+                out.append(
+                    Finding(
+                        check="lifecycle", path=sf.rel,
+                        line=stmt.lineno, col=stmt.col_offset + 1,
+                        message=(
+                            f"{pair.name}: result of "
+                            f"{call_name(stmt.value)}() is discarded — "
+                            "nothing can ever free this handle"
+                        ),
+                    )
+                )
+        out.extend(self._exception_leaks(pair, sf))
+        return out
+
+    def _exception_leaks(
+        self, pair: ResourcePair, sf: SourceFile
+    ) -> List[Finding]:
+        """An open BEFORE a try whose handler swallows-and-exits without
+        a close (and no finally closes): the exception path leaks."""
+        out: List[Finding] = []
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            opens = [
+                n for n in ast.walk(fn)
+                if isinstance(n, ast.Call) and _matches(pair, n)
+            ]
+            if not opens:
+                continue
+            for tr in ast.walk(fn):
+                if not isinstance(tr, ast.Try):
+                    continue
+                prior = [o for o in opens if o.lineno < tr.lineno]
+                if not prior:
+                    continue  # open inside the try: a failing open holds nothing
+                fin_closes = any(
+                    isinstance(c, ast.Call) and _is_close(pair, c)
+                    for f in tr.finalbody
+                    for c in ast.walk(f)
+                )
+                if fin_closes:
+                    continue
+                for handler in tr.handlers:
+                    closes = any(
+                        isinstance(c, ast.Call) and _is_close(pair, c)
+                        for s in handler.body
+                        for c in ast.walk(s)
+                    )
+                    reraises = any(
+                        isinstance(s, ast.Raise)
+                        for s in ast.walk(handler)  # incl. nested raise
+                    )
+                    exits = any(
+                        isinstance(s, (ast.Return, ast.Break, ast.Continue))
+                        for b in handler.body
+                        for s in ast.walk(b)
+                    )
+                    if exits and not closes and not reraises:
+                        out.append(
+                            Finding(
+                                check="lifecycle", path=sf.rel,
+                                line=handler.lineno,
+                                col=handler.col_offset + 1,
+                                message=(
+                                    f"{pair.name}: resource opened at "
+                                    f"line {prior[0].lineno} leaks on "
+                                    "this exception path — the handler "
+                                    "exits without any of "
+                                    f"{list(pair.close_suffixes)}; free "
+                                    "it in the handler or a finally"
+                                ),
+                            )
+                        )
+        return out
+
+    # -- shutdown-before-close sockets --------------------------------------
+
+    def _socket_findings(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            local_socks = _socket_vars(fn)
+            shutdowns: List[Tuple[str, int]] = []
+            closes: List[Tuple[str, int, ast.Call]] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                ident = _recv_ident(node.func)
+                if not ident:
+                    continue
+                sockish = ident in local_socks or any(
+                    k in ident.lower() for k in _SOCKETISH
+                )
+                if not sockish:
+                    continue
+                if node.func.attr == "shutdown":
+                    shutdowns.append((ident, node.lineno))
+                elif node.func.attr == "close" and not node.args:
+                    closes.append((ident, node.lineno, node))
+            for ident, line, node in closes:
+                if any(s_id == ident and s_line < line
+                       for s_id, s_line in shutdowns):
+                    continue
+                out.append(
+                    Finding(
+                        check="lifecycle", path=sf.rel,
+                        line=line, col=node.col_offset + 1,
+                        message=(
+                            f"socket {ident!r} is close()d without a "
+                            "preceding shutdown(SHUT_RDWR) in this "
+                            "function — a thread blocked in recv()/"
+                            "accept() on this socket is neither woken "
+                            "nor sent FIN (docs/serving.md \"Failure "
+                            "semantics\")"
+                        ),
+                    )
+                )
+        return out

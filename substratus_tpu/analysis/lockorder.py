@@ -1,0 +1,436 @@
+"""lockorder-lint: interprocedural lock-acquisition analysis.
+
+PRs 5–7 made the serving stack genuinely concurrent — gateway routing,
+the lock-guarded AdapterStore, the disagg KV-handoff threads — and a
+single deadlock in a lockstep gang stalls the whole slice. This family
+builds a lock-acquisition graph across the configured modules by
+resolving lock sites through each module's intra-class/intra-module
+call graph, then reports three hazard classes:
+
+  * **lock-order cycle**: lock B is acquired while A is held on one
+    path and A while B is held on another (including re-acquiring a
+    plain ``threading.Lock`` already held through a callee — a
+    guaranteed self-deadlock; ``RLock``-assigned attributes are
+    exempt). Edges propagate through calls: ``with self._a: self.m()``
+    contributes every acquisition ``m`` makes to ``_a``'s successors.
+  * **blocking-while-locked**: a known blocking call — ``recv``/
+    ``accept``/``recv_frame``, thread ``.join()``, queue ``.get()``
+    / ``Event.wait()`` without a timeout, ``time.sleep``,
+    ``socket.create_connection`` — reachable (lexically or through the
+    call graph) while a lock is held. A blocked holder starves every
+    other thread that needs the lock.
+  * **acquire-without-release-path**: a bare ``lock.acquire()`` whose
+    matching ``release()`` is not in a ``finally`` (or the acquire is
+    not itself the first statement guarded by ``try``) — an exception
+    between the two leaks the lock forever. Prefer ``with``.
+
+Lock identity is ``module.py:Class.attr`` (or ``module.py:name`` for
+module-level locks); an attribute counts as a lock when its identifier
+contains ``lock``/``mutex``/``cond``. Calls into the shared metrics
+registry (``METRICS.*``) are modeled as acquiring the registry lock —
+the one deliberate cross-module edge every instrumented module shares.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
+
+# Modules whose lock discipline is load-bearing (suffix match).
+DEFAULT_LOCK_MODULES: Tuple[str, ...] = (
+    "serve/engine.py",
+    "serve/server.py",
+    "serve/adapters.py",
+    "serve/disagg.py",
+    "serve/multihost.py",
+    "gateway/",
+    "observability/",
+)
+
+_LOCKISH = ("lock", "mutex", "cond")
+
+# Calls that are known to acquire a lock living in another module; the
+# metrics registry is the edge every instrumented module shares.
+EXTERNAL_LOCKS: Dict[str, str] = {
+    "METRICS.": "observability/metrics.py:Metrics._lock",
+}
+
+# Blocking calls by dotted-name suffix. `.join`/`.get`/`.wait` need the
+# receiver filters below to stay precise (str.join, dict.get, ...).
+_BLOCKING_SUFFIX = {
+    ".recv": "socket recv blocks until the peer writes",
+    ".recv_into": "socket recv blocks until the peer writes",
+    ".accept": "accept blocks until a client connects",
+    ".sendall": None,  # noisy; covered by frame-send discipline docs
+}
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep parks the holder",
+    "socket.create_connection": "connect blocks for the full timeout",
+    "recv_exact": "recv_exact blocks until the peer writes",
+    "recv_frame": "recv_frame blocks until the peer writes",
+    "select.select": "select blocks until a descriptor is ready",
+}
+
+
+def _lock_ident(expr: ast.AST) -> Optional[str]:
+    """The lock-ish identifier a with-item / call receiver names, or
+    None. `self._lock` -> "_lock", `REGISTRY_LOCK` -> "REGISTRY_LOCK"."""
+    node = expr
+    # Unwrap .acquire()/.release() attribute to the receiver.
+    if isinstance(node, ast.Attribute) and node.attr in ("acquire", "release"):
+        node = node.value
+    ident = None
+    if isinstance(node, ast.Attribute):
+        ident = node.attr
+    elif isinstance(node, ast.Name):
+        ident = node.id
+    if ident and any(k in ident.lower() for k in _LOCKISH):
+        return ident
+    return None
+
+
+def _is_blocking(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name in _BLOCKING_EXACT:
+        return _BLOCKING_EXACT[name]
+    last = name.rsplit(".", 1)[-1]
+    has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+    recv_ident = ""
+    if isinstance(node.func, ast.Attribute):
+        base = node.func.value
+        if isinstance(base, ast.Constant):
+            return None  # "sep".join(...) and friends
+        if isinstance(base, ast.Attribute):
+            recv_ident = base.attr
+        elif isinstance(base, ast.Name):
+            recv_ident = base.id
+    for suffix, why in _BLOCKING_SUFFIX.items():
+        if why and ("." + last) == suffix:
+            return why
+    if last == "join" and not node.args and not name.startswith("os.path"):
+        # Thread/process join: receiver looks like a thread handle.
+        if any(
+            k in recv_ident.lower()
+            for k in ("thread", "worker", "sender", "proc", "_t")
+        ) or recv_ident in ("t", "th"):
+            return "join blocks until the thread exits"
+    if last == "get" and not has_timeout and not node.args:
+        # queue.Queue.get() without timeout (dict.get always has args).
+        if "queue" in recv_ident.lower() or recv_ident in ("q",):
+            return "Queue.get() without timeout blocks indefinitely"
+    if last == "wait" and not has_timeout and not node.args:
+        if any(
+            k in recv_ident.lower()
+            for k in ("event", "cond", "stop", "ready", "done")
+        ):
+            return "wait() without timeout blocks indefinitely"
+    return None
+
+
+def _index_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+
+def _rlock_attrs(tree: ast.Module) -> Set[str]:
+    """Attribute/name identifiers assigned from threading.RLock() —
+    re-acquiring those while held is legal."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if call_name(node.value).endswith("RLock"):
+                for t in node.targets:
+                    ident = _lock_ident(t)
+                    if ident:
+                        out.add(ident)
+    return out
+
+
+class _FnSummary:
+    """Per-function facts: lock events and call edges, each with the
+    set of locks lexically held at that point."""
+
+    def __init__(self) -> None:
+        # (lockid, node, held-at-site)
+        self.acquires: List[Tuple[str, ast.AST, frozenset]] = []
+        # (callee qualname, held-at-site)
+        self.calls: List[Tuple[str, frozenset]] = []
+        # (message, node, held-at-site)
+        self.blocking: List[Tuple[str, ast.AST, frozenset]] = []
+        # external lock ids touched, with held-at-site
+        self.external: List[Tuple[str, ast.AST, frozenset]] = []
+        # bare .acquire() without try/finally release (node, lockid)
+        self.bare_acquires: List[Tuple[ast.AST, str]] = []
+
+
+def _summarize(
+    rel: str, qual: str, fn: ast.AST, index: Dict[str, ast.AST],
+    rlocks: Set[str],
+) -> _FnSummary:
+    cls = qual.split(".")[0] if "." in qual else None
+    out = _FnSummary()
+
+    def lock_id(ident: str) -> str:
+        scope = cls if cls else ""
+        return f"{rel}:{scope + '.' if scope else ''}{ident}"
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if (
+            node is not fn
+            and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+        ):
+            return  # nested defs run on their own schedule/thread
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                visit(item.context_expr, held)
+                ident = _lock_ident(item.context_expr)
+                if ident:
+                    lid = lock_id(ident)
+                    out.acquires.append((lid, node, held))
+                    inner = inner | {lid}
+            for sub in node.body:
+                visit(sub, inner)
+            return
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                ident = _lock_ident(node.func)
+                if ident:
+                    out.acquires.append((lock_id(ident), node, held))
+                    out.bare_acquires.append((node, ident))
+            for prefix, lid in EXTERNAL_LOCKS.items():
+                if name.startswith(prefix):
+                    out.external.append((lid, node, held))
+            why = _is_blocking(node)
+            if why:
+                out.blocking.append((why, node, held))
+            f = node.func
+            if (
+                cls is not None
+                and isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls")
+                and f"{cls}.{f.attr}" in index
+            ):
+                out.calls.append((f"{cls}.{f.attr}", held))
+            elif isinstance(f, ast.Name) and f.id in index:
+                out.calls.append((f.id, held))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    out.rlock_ids = {lock_id(i) for i in rlocks}  # type: ignore[attr-defined]
+    visit(fn, frozenset())
+    return out
+
+
+class LockOrderCheck(Check):
+    name = "lockorder"
+    description = (
+        "interprocedural lock analysis over the serving/gateway/"
+        "observability modules: lock-order cycles, blocking calls while "
+        "holding a lock, bare acquire() without a finally-guarded release"
+    )
+
+    def __init__(self, modules: Sequence[str] = DEFAULT_LOCK_MODULES):
+        self.modules = tuple(modules)
+
+    def _in_scope(self, rel: str) -> bool:
+        return any(m in rel for m in self.modules)
+
+    @staticmethod
+    def _canon(lid: str) -> str:
+        """Unify in-module lock ids (full repo-relative path) with the
+        EXTERNAL_LOCKS suffix form, so the metrics registry acquired
+        from inside metrics.py and via METRICS.* is ONE graph node."""
+        for ext in EXTERNAL_LOCKS.values():
+            if lid.endswith(ext):
+                return ext
+        return lid
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        # lock graph: edge (held -> acquired) with one witness site
+        edges: Dict[Tuple[str, str], Tuple[str, int, int]] = {}
+        rlock_ids: Set[str] = set()
+
+        for rel, sf in sorted(files.items()):
+            if sf.tree is None or not self._in_scope(rel):
+                continue
+            index = _index_functions(sf.tree)
+            rlocks = _rlock_attrs(sf.tree)
+            summaries = {
+                qual: _summarize(rel, qual, fn, index, rlocks)
+                for qual, fn in index.items()
+            }
+            for s in summaries.values():
+                rlock_ids |= {
+                    self._canon(x) for x in getattr(s, "rlock_ids", set())
+                }
+
+            # Interprocedural propagation: visit (fn, inherited-held).
+            seen: Set[Tuple[str, frozenset]] = set()
+            work: List[Tuple[str, frozenset]] = [
+                (q, frozenset()) for q in summaries
+            ]
+            while work:
+                qual, inherited = work.pop()
+                if (qual, inherited) in seen:
+                    continue
+                seen.add((qual, inherited))
+                s = summaries[qual]
+                for lid, node, held in s.acquires:
+                    lid = self._canon(lid)
+                    for h in held | inherited:
+                        h = self._canon(h)
+                        if h == lid and lid in rlock_ids:
+                            continue
+                        edges.setdefault(
+                            (h, lid),
+                            (rel, node.lineno, node.col_offset + 1),
+                        )
+                for lid, node, held in s.external:
+                    for h in held | inherited:
+                        edges.setdefault(
+                            (self._canon(h), lid),
+                            (rel, node.lineno, node.col_offset + 1),
+                        )
+                for why, node, held in s.blocking:
+                    all_held = held | inherited
+                    if all_held:
+                        findings.append(
+                            Finding(
+                                check="lockorder", path=rel,
+                                line=node.lineno, col=node.col_offset + 1,
+                                message=(
+                                    f"{why} while holding "
+                                    f"{sorted(all_held)} (in {qual}) — "
+                                    "every thread needing the lock stalls "
+                                    "behind this call; move it outside "
+                                    "the critical section"
+                                ),
+                            )
+                        )
+                for node, ident in s.bare_acquires:
+                    if not _released_in_finally(
+                        index[qual], node, ident
+                    ):
+                        findings.append(
+                            Finding(
+                                check="lockorder", path=rel,
+                                line=node.lineno, col=node.col_offset + 1,
+                                message=(
+                                    f"{ident}.acquire() without a "
+                                    "finally-guarded release — an "
+                                    "exception on this path leaks the "
+                                    "lock forever; use `with` or "
+                                    "try/finally"
+                                ),
+                            )
+                        )
+                for callee, held in s.calls:
+                    work.append((callee, held | inherited))
+
+        findings.extend(_cycle_findings(edges, rlock_ids))
+        return findings
+
+
+def _released_in_finally(fn: ast.AST, acquire: ast.Call, ident: str) -> bool:
+    """True when the acquire's release is exception-safe: the acquire
+    sits immediately before (or as the first statement of) a try whose
+    finally releases the same lock identifier."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        releases = any(
+            isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Attribute)
+            and c.func.attr == "release"
+            and _lock_ident(c.func) == ident
+            for f in node.finalbody
+            for c in ast.walk(f)
+        )
+        if not releases:
+            continue
+        # acquire just before the try, or the try's first statement
+        if acquire.lineno <= node.lineno:
+            return True
+        first = node.body[0] if node.body else None
+        if first is not None and acquire.lineno <= first.lineno:
+            return True
+    return False
+
+
+def _cycle_findings(
+    edges: Dict[Tuple[str, str], Tuple[str, int, int]],
+    rlock_ids: Set[str],
+) -> List[Finding]:
+    out: List[Finding] = []
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    # Self-deadlock: plain lock re-acquired while held.
+    for (a, b), (rel, line, col) in sorted(edges.items()):
+        if a == b and a not in rlock_ids:
+            out.append(
+                Finding(
+                    check="lockorder", path=rel, line=line, col=col,
+                    message=(
+                        f"lock {a} re-acquired while already held "
+                        "(through the call graph) — threading.Lock is "
+                        "not re-entrant; this deadlocks the holder"
+                    ),
+                )
+            )
+
+    # Simple cycle detection via DFS over distinct nodes; report each
+    # 2+-node cycle once, anchored at its lexically-first edge site.
+    reported: Set[frozenset] = set()
+
+    def reachable(src: str, dst: str) -> bool:
+        seen, stack = {src}, [src]
+        while stack:
+            cur = stack.pop()
+            for nxt in graph.get(cur, ()):
+                if nxt == dst:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    for (a, b), (rel, line, col) in sorted(edges.items()):
+        if a == b:
+            continue
+        key = frozenset((a, b))
+        if key in reported:
+            continue
+        if reachable(b, a):
+            reported.add(key)
+            out.append(
+                Finding(
+                    check="lockorder", path=rel, line=line, col=col,
+                    message=(
+                        f"lock-order cycle: {a} is held while acquiring "
+                        f"{b} here, and {b} is (transitively) held while "
+                        f"acquiring {a} elsewhere — two threads taking "
+                        "the two orders deadlock; pick one global order"
+                    ),
+                )
+            )
+    return out

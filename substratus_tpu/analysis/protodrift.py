@@ -1,0 +1,566 @@
+"""protodrift-lint: producer/consumer agreement on hand-rolled wire
+formats.
+
+The serving stack has four hand-rolled protocols whose two ends live in
+different modules (or different processes): the ``x-substratus-load``
+header (gateway/loadreport.py), the disagg KV-handoff frames
+(serve/disagg.py), the hello/PoolSpec negotiation, and the lockstep
+gang event broadcast (serve/multihost.py -> serve/engine.py). A key
+written on one side and dropped on the other is silent data loss — the
+gateway quietly stops seeing transfer backlog, a decode worker ignores
+a sampling parameter — so this family extracts the emitted and parsed
+key sets from both ends and flags the symmetric difference:
+
+  * **kvheader** protocols: producer keys from ``k=`` literals in
+    f-strings/constants; consumer keys from ``.get("k")`` calls and
+    ``== "k"`` comparisons.
+  * **dict** protocols: producer keys from dict-literal string keys in
+    the producer function; consumer keys from ``var["k"]`` /
+    ``var.get("k")`` reads in the consumer function.
+  * **frames** protocols: producer keys and ``"t"`` message kinds from
+    dict literals passed to ``send``/``send_frame`` calls; consumer
+    keys/kinds from reads of recv_frame-unpacked header variables.
+  * **endian**: ``struct.pack``/``unpack`` and numpy dtype strings in
+    the wire modules must carry an explicit byte order (the
+    ``multihost.py`` big-endian-host lesson), and the pack side's
+    (order, width) pairs must meet a matching read (``"<I"`` must meet
+    ``"<I"``/``"<u4"`` — never a native-order view).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
+
+_KV_KEY_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)=")
+
+
+@dataclass(frozen=True)
+class ProtoSpec:
+    """One protocol: where its two ends live and how to read them.
+
+    kind:
+      * "kvheader": producer/consumer are (module_suffix, qualname)
+        function refs; keys are `k=` literals vs get()/== reads.
+      * "dict": dict-literal keys in producer fn vs subscript/get reads
+        on local names in consumer fn.
+      * "frames": producer/consumer are module suffixes; send-dict
+        literals vs tracked header-var reads, plus "t" kind agreement.
+    """
+
+    name: str
+    kind: str
+    producers: Tuple[Tuple[str, str], ...]
+    consumers: Tuple[Tuple[str, str], ...]
+    # keys exempt from the drift check (documented one-sided fields)
+    ignore: Tuple[str, ...] = ()
+
+
+DEFAULT_PROTOCOLS: Tuple[ProtoSpec, ...] = (
+    ProtoSpec(
+        name="x-substratus-load",
+        kind="kvheader",
+        producers=(("gateway/loadreport.py", "LoadReport.to_header"),),
+        consumers=(("gateway/loadreport.py", "LoadReport.from_header"),),
+    ),
+    ProtoSpec(
+        name="disagg-frames",
+        kind="frames",
+        producers=(("serve/disagg.py", ""),),
+        consumers=(("serve/disagg.py", ""),),
+    ),
+    ProtoSpec(
+        name="poolspec-negotiation",
+        kind="dict",
+        producers=(("serve/disagg.py", "PoolSpec.to_dict"),),
+        consumers=(("serve/disagg.py", "PoolSpec.from_dict"),),
+    ),
+    ProtoSpec(
+        name="gang-events",
+        kind="dict",
+        producers=(("serve/multihost.py", "encode_events"),),
+        consumers=(("serve/engine.py", "Engine._sync_iterate"),),
+    ),
+)
+
+# Wire modules whose struct/numpy formats must be byte-order explicit.
+DEFAULT_ENDIAN_MODULES: Tuple[str, ...] = (
+    "serve/disagg.py",
+    "serve/multihost.py",
+)
+
+# struct format characters that occupy >1 byte (order matters).
+_MULTIBYTE_STRUCT = set("hHiIlLqQefd")
+_STRUCT_FMT_RE = re.compile(r"^[@=<>!]?[0-9hHiIlLqQefdbBsxc]+$")
+_NP_FMT_RE = re.compile(r"^([<>=|]?)([uif])(\d)$")
+
+# struct char -> numpy (kindchar, bytes) equivalence for pairing.
+_STRUCT_TO_NP = {
+    "h": ("i", 2), "H": ("u", 2), "i": ("i", 4), "I": ("u", 4),
+    "l": ("i", 8), "L": ("u", 8), "q": ("i", 8), "Q": ("u", 8),
+    "e": ("f", 2), "f": ("f", 4), "d": ("f", 8),
+}
+
+
+def _find_fn(
+    files: Dict[str, SourceFile], ref: Tuple[str, str]
+) -> Optional[Tuple[SourceFile, ast.AST]]:
+    suffix, qual = ref
+    for rel, sf in sorted(files.items()):
+        if not rel.endswith(suffix) or sf.tree is None:
+            continue
+        if not qual:
+            return sf, sf.tree
+        cls_name, _, fn_name = qual.rpartition(".")
+        for node in sf.tree.body:
+            if cls_name and isinstance(node, ast.ClassDef) \
+                    and node.name == cls_name:
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and sub.name == fn_name:
+                        return sf, sub
+            elif not cls_name and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name == qual:
+                return sf, node
+    return None
+
+
+def _str_fragments(fn: ast.AST) -> Iterable[Tuple[str, int]]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node.lineno
+        elif isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.Constant) and isinstance(
+                    part.value, str
+                ):
+                    yield part.value, node.lineno
+
+
+def _kvheader_emitted(fn: ast.AST) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for text, line in _str_fragments(fn):
+        for m in _KV_KEY_RE.finditer(text):
+            out.setdefault(m.group(1), line)
+    return out
+
+
+def _read_keys(fn: ast.AST, tracked: Optional[Set[str]] = None) -> Dict[str, int]:
+    """Keys read in `fn`: var["k"] subscripts, var.get("k") calls, and
+    `x == "k"` comparisons. `tracked` restricts the subscript/get
+    receivers to specific local names (frames kind); comparisons are
+    always collected (the `k == "ad"` loop-dispatch idiom)."""
+    out: Dict[str, int] = {}
+
+    def rec(key: str, line: int) -> None:
+        out.setdefault(key, line)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.value, ast.Name
+        ):
+            if tracked is not None and node.value.id not in tracked:
+                continue
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                rec(sl.value, node.lineno)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr == "get" and node.args:
+            base = node.func.value
+            if not isinstance(base, ast.Name):
+                continue
+            if tracked is not None and base.id not in tracked:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                rec(first.value, node.lineno)
+        elif tracked is None and isinstance(node, ast.Compare):
+            left_is_name = isinstance(node.left, ast.Name)
+            for op, comp in zip(node.ops, node.comparators):
+                if not isinstance(op, (ast.Eq, ast.NotEq)):
+                    continue
+                if isinstance(comp, ast.Constant) and isinstance(
+                    comp.value, str
+                ) and left_is_name:
+                    rec(comp.value, node.lineno)
+    return out
+
+
+def _dict_literal_keys(fn: ast.AST) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.setdefault(k.value, k.lineno)
+    return out
+
+
+# -- frames kind -----------------------------------------------------------
+
+
+def _frames_produced(tree: ast.Module) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(header keys, "t" kinds) from dict literals handed to send-like
+    calls anywhere in the module — inline, or assigned to a local name
+    one step earlier (`header = {...}; ch.send(header, payload)`)."""
+    keys: Dict[str, int] = {}
+    kinds: Dict[str, int] = {}
+
+    def record(d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.setdefault(k.value, k.lineno)
+                if (
+                    k.value == "t"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    kinds.setdefault(v.value, v.lineno)
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_dicts: Dict[str, ast.Dict] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Dict
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_dicts[t.id] = node.value
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            last = name.rsplit(".", 1)[-1]
+            if last not in ("send", "send_frame"):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Dict):
+                    record(arg)
+                elif isinstance(arg, ast.Name) and arg.id in local_dicts:
+                    record(local_dicts[arg.id])
+    return keys, kinds
+
+
+_TRACKED_PARAMS = ("header", "hello", "reply", "frame")
+
+
+def _frames_consumed(tree: ast.Module) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(header keys, "t" kinds) read from recv_frame-unpacked variables
+    and header-named parameters, module-wide."""
+    keys: Dict[str, int] = {}
+    kinds: Dict[str, int] = {}
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tracked: Set[str] = {
+            a.arg for a in fn.args.args if a.arg in _TRACKED_PARAMS
+        }
+        kind_vars: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                vname = call_name(node.value)
+                if vname.endswith("recv_frame"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Tuple) and t.elts:
+                            first = t.elts[0]
+                            if isinstance(first, ast.Name):
+                                tracked.add(first.id)
+                        elif isinstance(t, ast.Name):
+                            tracked.add(t.id)
+        if not tracked:
+            continue
+        for k, line in _read_keys(fn, tracked).items():
+            keys.setdefault(k, line)
+        # kind variables: x = header.get("t")
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "get"
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.func.value.id in tracked
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+                and node.value.args[0].value == "t"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        kind_vars.add(t.id)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            left = node.left
+            left_kind = (
+                isinstance(left, ast.Name) and left.id in kind_vars
+            ) or (
+                isinstance(left, ast.Call)
+                and isinstance(left.func, ast.Attribute)
+                and left.func.attr == "get"
+                and isinstance(left.func.value, ast.Name)
+                and left.func.value.id in tracked
+                and left.args
+                and isinstance(left.args[0], ast.Constant)
+                and left.args[0].value == "t"
+            )
+            if not left_kind:
+                continue
+            for comp in node.comparators:
+                if isinstance(comp, ast.Constant) and isinstance(
+                    comp.value, str
+                ):
+                    kinds.setdefault(comp.value, node.lineno)
+    return keys, kinds
+
+
+# -- endianness ------------------------------------------------------------
+
+
+def _endian_sites(
+    tree: ast.Module,
+) -> Tuple[List[Tuple[str, str, int]], List[Tuple[str, str, int]]]:
+    """(writes, reads) as (fmt, normalized, line). Writes are
+    struct.pack; reads are struct.unpack / np.dtype("<u4")-style
+    strings / ndarray.view. Normalization maps struct chars to numpy
+    (order, kind, size) triples so "<I" pairs with "<u4"."""
+    writes: List[Tuple[str, str, int]] = []
+    reads: List[Tuple[str, str, int]] = []
+
+    def norm_struct(fmt: str) -> List[str]:
+        order = fmt[0] if fmt[:1] in "@=<>!" else "@"
+        order = {"!": ">"}.get(order, order)
+        out = []
+        for ch in fmt:
+            if ch in _STRUCT_TO_NP and ch in _MULTIBYTE_STRUCT:
+                kind, size = _STRUCT_TO_NP[ch]
+                out.append(f"{order}{kind}{size}")
+        return out
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        last = name.rsplit(".", 1)[-1]
+        if last in ("pack", "pack_into", "unpack", "unpack_from", "Struct"):
+            if not node.args:
+                continue
+            fmt = node.args[0]
+            if not (
+                isinstance(fmt, ast.Constant) and isinstance(fmt.value, str)
+                and _STRUCT_FMT_RE.match(fmt.value)
+            ):
+                continue
+            entries = norm_struct(fmt.value)
+            target = writes if last.startswith("pack") else reads
+            for e in entries:
+                target.append((fmt.value, e, node.lineno))
+        elif last in ("dtype", "view", "frombuffer"):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    m = _NP_FMT_RE.match(arg.value)
+                    if m and int(m.group(3)) > 1:
+                        order = m.group(1) or "@"
+                        reads.append(
+                            (
+                                arg.value,
+                                f"{order}{m.group(2)}{m.group(3)}",
+                                node.lineno,
+                            )
+                        )
+    return writes, reads
+
+
+class ProtoDriftCheck(Check):
+    name = "protodrift"
+    description = (
+        "producer/consumer key agreement on the hand-rolled wire "
+        "formats (x-substratus-load header, disagg frames, PoolSpec "
+        "negotiation, gang event broadcast) and explicit-byte-order "
+        "struct/numpy pairing in the wire modules"
+    )
+
+    def __init__(
+        self,
+        protocols: Sequence[ProtoSpec] = DEFAULT_PROTOCOLS,
+        endian_modules: Sequence[str] = DEFAULT_ENDIAN_MODULES,
+    ):
+        self.protocols = tuple(protocols)
+        self.endian_modules = tuple(endian_modules)
+
+    def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for spec in self.protocols:
+            out.extend(self._proto_findings(spec, files))
+        out.extend(self._endian_findings(files))
+        return out
+
+    def _proto_findings(
+        self, spec: ProtoSpec, files: Dict[str, SourceFile]
+    ) -> List[Finding]:
+        produced: Dict[str, Tuple[str, int]] = {}
+        consumed: Dict[str, Tuple[str, int]] = {}
+        p_kinds: Dict[str, Tuple[str, int]] = {}
+        c_kinds: Dict[str, Tuple[str, int]] = {}
+        found_any = False
+
+        for ref in spec.producers:
+            hit = _find_fn(files, ref)
+            if hit is None:
+                continue
+            sf, fn = hit
+            found_any = True
+            if spec.kind == "kvheader":
+                src = _kvheader_emitted(fn)
+            elif spec.kind == "dict":
+                src = _dict_literal_keys(fn)
+            else:  # frames: module-wide
+                src, kinds = _frames_produced(sf.tree)
+                for k, line in kinds.items():
+                    p_kinds.setdefault(k, (sf.rel, line))
+            for k, line in src.items():
+                produced.setdefault(k, (sf.rel, line))
+
+        for ref in spec.consumers:
+            hit = _find_fn(files, ref)
+            if hit is None:
+                continue
+            sf, fn = hit
+            found_any = True
+            if spec.kind == "frames":
+                src, kinds = _frames_consumed(sf.tree)
+                for k, line in kinds.items():
+                    c_kinds.setdefault(k, (sf.rel, line))
+            else:
+                src = _read_keys(
+                    fn, tracked=None
+                )
+            for k, line in src.items():
+                consumed.setdefault(k, (sf.rel, line))
+
+        out: List[Finding] = []
+        if not found_any:
+            return out  # protocol's modules not in this lint scope
+        ignore = set(spec.ignore)
+        for k, (rel, line) in sorted(produced.items()):
+            if k in consumed or k in ignore:
+                continue
+            out.append(
+                Finding(
+                    check="protodrift", path=rel, line=line, col=1,
+                    message=(
+                        f"protocol {spec.name!r}: key {k!r} is emitted "
+                        "by the producer but never parsed by the "
+                        "consumer — drift, or dead weight on the wire"
+                    ),
+                )
+            )
+        for k, (rel, line) in sorted(consumed.items()):
+            if k in produced or k in ignore:
+                continue
+            out.append(
+                Finding(
+                    check="protodrift", path=rel, line=line, col=1,
+                    message=(
+                        f"protocol {spec.name!r}: key {k!r} is parsed "
+                        "by the consumer but never emitted by the "
+                        "producer — it silently reads its default "
+                        "forever"
+                    ),
+                )
+            )
+        for k, (rel, line) in sorted(p_kinds.items()):
+            if k not in c_kinds and k not in ignore:
+                out.append(
+                    Finding(
+                        check="protodrift", path=rel, line=line, col=1,
+                        message=(
+                            f"protocol {spec.name!r}: message kind "
+                            f"{k!r} is sent but no receiver dispatches "
+                            "on it — the peer drops it on the floor"
+                        ),
+                    )
+                )
+        for k, (rel, line) in sorted(c_kinds.items()):
+            if k not in p_kinds and k not in ignore:
+                out.append(
+                    Finding(
+                        check="protodrift", path=rel, line=line, col=1,
+                        message=(
+                            f"protocol {spec.name!r}: message kind "
+                            f"{k!r} is dispatched on but never sent — "
+                            "dead protocol arm, or the sender renamed it"
+                        ),
+                    )
+                )
+        return out
+
+    def _endian_findings(
+        self, files: Dict[str, SourceFile]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        all_writes: List[Tuple[str, str, str, int]] = []
+        all_reads: List[Tuple[str, str, str, int]] = []
+        for rel, sf in sorted(files.items()):
+            if sf.tree is None or not any(
+                rel.endswith(m) for m in self.endian_modules
+            ):
+                continue
+            writes, reads = _endian_sites(sf.tree)
+            for fmt, norm, line in writes + reads:
+                if norm.startswith("@") or norm.startswith("="):
+                    out.append(
+                        Finding(
+                            check="protodrift", path=rel, line=line, col=1,
+                            message=(
+                                f"wire format {fmt!r} has no explicit "
+                                "byte order — native order differs "
+                                "between hosts (the multihost.py "
+                                "big-endian lesson); write '<' or '>'"
+                            ),
+                        )
+                    )
+            all_writes.extend((rel, f, n, l) for f, n, l in writes)
+            all_reads.extend((rel, f, n, l) for f, n, l in reads)
+        read_norms = {n for _, _, n, _ in all_reads}
+        write_norms = {n for _, _, n, _ in all_writes}
+        for rel, fmt, norm, line in all_writes:
+            if norm.startswith(("@", "=")) or norm in read_norms:
+                continue
+            out.append(
+                Finding(
+                    check="protodrift", path=rel, line=line, col=1,
+                    message=(
+                        f"struct.pack format {fmt!r} ({norm}) has no "
+                        "matching-endianness read anywhere in the wire "
+                        "modules — the other side decodes garbage"
+                    ),
+                )
+            )
+        for rel, fmt, norm, line in all_reads:
+            if norm.startswith(("@", "=")) or norm in write_norms:
+                continue
+            out.append(
+                Finding(
+                    check="protodrift", path=rel, line=line, col=1,
+                    message=(
+                        f"wire read format {fmt!r} ({norm}) has no "
+                        "matching-endianness writer anywhere in the "
+                        "wire modules — sender and reader disagree"
+                    ),
+                )
+            )
+        return out

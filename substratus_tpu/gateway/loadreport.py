@@ -16,6 +16,11 @@ server exposes the snapshot two ways:
 The header value is a comma-joined `k=v` list (`q=3 a=2 m=8 kvf=0.75`
 shaped), chosen over JSON so it never needs quoting inside an HTTP
 header and stays greppable in access logs.
+
+Wire-contract note: sublint's `protodrift` family statically checks
+that every key `to_header` emits is parsed by `from_header` and vice
+versa (docs/development.md#static-analysis-sublint) — add both sides
+in the same change or `make lint` fails.
 """
 from __future__ import annotations
 

@@ -729,6 +729,14 @@ class HandoffServer:
         finally:
             if ch is not None:
                 ch.dead = True
+            # shutdown() before close(), same as everywhere else in this
+            # module: the decode engine's scheduler thread may be inside
+            # a _RemoteSink sendall() on this socket right now — a bare
+            # close() neither unblocks it nor sends FIN to the peer.
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:

@@ -35,6 +35,7 @@ once.
 from __future__ import annotations
 
 import json
+import socket
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -158,8 +159,6 @@ class TcpSync(_TimedSync):
 
     def __init__(self, process_index: int, num_processes: int, port: int,
                  host: str = "127.0.0.1", timeout: float = 120.0) -> None:
-        import socket
-
         self.process_index = process_index
         self.num_processes = num_processes
         self.leader = process_index == 0
@@ -176,7 +175,7 @@ class TcpSync(_TimedSync):
             self._conns = [
                 srv.accept()[0] for _ in range(num_processes - 1)
             ]
-            srv.close()
+            srv.close()  # sublint: allow[lifecycle]: listener past its final accept; no thread blocks on it
         else:
             deadline = time.monotonic() + timeout
             while True:
@@ -216,6 +215,13 @@ class TcpSync(_TimedSync):
 
     def close(self) -> None:
         for c in self._conns:
+            # shutdown() before close(), the serve/disagg.py discipline:
+            # a follower blocked in _broadcast's recv on another thread
+            # would neither wake nor see FIN from a bare close().
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:

@@ -1,12 +1,16 @@
 """sublint static-analysis subsystem (substratus_tpu/analysis/).
 
-Three layers, per the PR contract:
+Four layers, per the PR contract:
 
   * fixture snippets that MUST flag and MUST pass for each check family
-    (shard / hostsync / concurrency / broad-except);
+    (shard / hostsync / concurrency / broad-except / lockorder /
+    lifecycle / protodrift);
   * suppression-syntax round trips: a reasoned allow[] suppresses, a
     reasonless or unused one is itself a finding, and docstrings that
     merely mention the syntax never count;
+  * baseline-diff round trips: stable fingerprints, old findings
+    ignored via --baseline, new findings fail, the suppression-count
+    ratchet trips;
   * a self-lint gate: the shipped tree is clean — zero unsuppressed
     findings, every suppression reasoned — so `make lint` can never rot
     silently between CI runs.
@@ -24,7 +28,12 @@ from substratus_tpu.analysis import (
     BroadExceptCheck,
     ConcurrencyCheck,
     HostSyncCheck,
+    LifecycleCheck,
+    LockOrderCheck,
+    ProtoDriftCheck,
     ShardCheck,
+    assign_fingerprints,
+    baseline_fingerprints,
     load_files,
     discover,
     parse_suppressions,
@@ -32,6 +41,8 @@ from substratus_tpu.analysis import (
     render_sarif,
     run_checks,
 )
+from substratus_tpu.analysis.lifecycle import ResourcePair
+from substratus_tpu.analysis.protodrift import ProtoSpec
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REGISTRY = ("data", "stage", "fsdp", "sequence", "tensor", "expert")
@@ -529,6 +540,426 @@ def test_axis_helpers_are_the_mesh_module_singletons():
     assert mesh.axis_names(("data", "fsdp")) == ("data", "fsdp")
 
 
+# --- lockorder ------------------------------------------------------------
+
+
+def lockorder_check():
+    return LockOrderCheck(modules=("pkg/",))
+
+
+def test_lockorder_flags_two_lock_cycle_interprocedurally(tmp_path):
+    # one() holds _alock and calls two(), which takes _block then
+    # _alock: the A->B and B->A orders both exist -> deadlock.
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def one(self):
+                with self._alock:
+                    self.two()
+
+            def two(self):
+                with self._block:
+                    with self._alock:
+                        pass
+        """,
+        [lockorder_check()],
+    )
+    msgs = [f.message for f in active(findings, "lockorder")]
+    assert any("lock-order cycle" in m for m in msgs), msgs
+    # The plain-Lock re-acquire through the call graph is also caught.
+    assert any("re-acquired while already held" in m for m in msgs), msgs
+
+
+def test_lockorder_consistent_order_passes(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def one(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def other(self):
+                with self._alock:
+                    with self._block:
+                        pass
+        """,
+        [lockorder_check()],
+    )
+    assert active(findings) == []
+
+
+def test_lockorder_rlock_reentry_is_legal(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """,
+        [lockorder_check()],
+    )
+    assert active(findings) == []
+
+
+def test_lockorder_flags_blocking_call_while_locked(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def drain(self):
+                with self._lock:
+                    item = self._queue.get()
+                return item
+
+            def drain_bounded(self):
+                with self._lock:
+                    return self._queue.get(timeout=0.2)
+
+            def relay(self, sock):
+                with self._lock:
+                    self._pump(sock)
+
+            def _pump(self, sock):
+                return sock.recv(4096)
+        """,
+        [lockorder_check()],
+    )
+    msgs = [f.message for f in active(findings, "lockorder")]
+    # Queue.get() without timeout, and the recv reached THROUGH _pump;
+    # the timeout'd get is legal.
+    assert len(msgs) == 2, msgs
+    assert any("Queue.get" in m for m in msgs)
+    assert any("recv" in m and "_pump" in m for m in msgs)
+
+
+def test_lockorder_bare_acquire_without_finally_flagged(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def leaky(self):
+                self._lock.acquire()
+                work()
+                self._lock.release()
+
+            def safe(self):
+                self._lock.acquire()
+                try:
+                    work()
+                finally:
+                    self._lock.release()
+        """,
+        [lockorder_check()],
+    )
+    msgs = [f.message for f in active(findings, "lockorder")]
+    assert len(msgs) == 1 and "finally-guarded release" in msgs[0], msgs
+
+
+# --- lifecycle ------------------------------------------------------------
+
+KV_PAIR = ResourcePair(
+    name="kv-page",
+    open_suffixes=(".alloc",),
+    close_suffixes=(".decref", ".release", ".free"),
+    receiver_hints=("alloc", "pool"),
+    modules=("pkg/mod.py",),
+)
+
+
+def lifecycle_check():
+    return LifecycleCheck(
+        resources=(KV_PAIR,), socket_modules=("pkg/mod.py",)
+    )
+
+
+def test_lifecycle_flags_exception_path_leak(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def board(pool):
+            pid = pool.alloc()
+            try:
+                risky(pid)
+            except Exception:
+                return None
+            pool.decref(pid)
+            return pid
+        """,
+        [lifecycle_check()],
+    )
+    msgs = [f.message for f in active(findings, "lifecycle")]
+    assert len(msgs) == 1 and "leaks on this exception path" in msgs[0], msgs
+
+
+def test_lifecycle_finally_and_handler_frees_pass(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def board(pool):
+            pid = pool.alloc()
+            try:
+                risky(pid)
+            except Exception:
+                pool.decref(pid)
+                return None
+            pool.decref(pid)
+            return pid
+
+        def board_finally(pool):
+            pid = pool.alloc()
+            try:
+                risky(pid)
+            finally:
+                pool.decref(pid)
+
+        def open_inside_try(pool):
+            try:
+                pid = pool.alloc()
+            except Exception:
+                return None  # a failing alloc holds nothing
+            pool.decref(pid)
+        """,
+        [lifecycle_check()],
+    )
+    assert active(findings) == []
+
+
+def test_lifecycle_flags_discarded_handle_and_unbalanced_module(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def fire_and_forget(pool):
+            pool.alloc()
+        """,
+        [lifecycle_check()],
+    )
+    msgs = [f.message for f in active(findings, "lifecycle")]
+    # No close call anywhere in the module -> every open flags.
+    assert len(msgs) == 1 and "never calls" in msgs[0], msgs
+
+
+def test_lifecycle_flags_close_without_shutdown(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import socket
+
+        def sever(conn):
+            conn.close()
+
+        def sever_properly(conn):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        """,
+        [lifecycle_check()],
+    )
+    msgs = [f.message for f in active(findings, "lifecycle")]
+    assert len(msgs) == 1 and "shutdown(SHUT_RDWR)" in msgs[0], msgs
+
+
+def test_lifecycle_socket_scope_is_module_gated(tmp_path):
+    # Outside the configured socket modules, bare close() is fine.
+    findings = lint_snippet(
+        tmp_path,
+        "def sever(conn):\n    conn.close()\n",
+        [LifecycleCheck(resources=(), socket_modules=("other/mod.py",))],
+    )
+    assert active(findings) == []
+
+
+# --- protodrift -----------------------------------------------------------
+
+DRIFT_SRC = """
+import struct
+import numpy as np
+
+
+class Report:
+    def to_header(self):
+        out = f"q={{self.q}} a={{self.a}}"
+        if self.tq:
+            out += f" tq={{self.tq}}"
+        return out
+
+    @classmethod
+    def from_header(cls, value):
+        kv = {{}}
+        for part in value.split():
+            k, _, v = part.partition("=")
+            kv[k] = v
+        return cls(q=kv.get("q"), a=kv.get("a"){consume_tq})
+"""
+
+
+def proto_check(endian_modules=()):
+    spec = ProtoSpec(
+        name="hdr",
+        kind="kvheader",
+        producers=(("pkg/mod.py", "Report.to_header"),),
+        consumers=(("pkg/mod.py", "Report.from_header"),),
+    )
+    return ProtoDriftCheck(
+        protocols=(spec,), endian_modules=endian_modules
+    )
+
+
+def test_protodrift_flags_dropped_header_key(tmp_path):
+    findings = lint_snippet(
+        tmp_path, DRIFT_SRC.format(consume_tq=""), [proto_check()],
+    )
+    msgs = [f.message for f in active(findings, "protodrift")]
+    assert len(msgs) == 1, msgs
+    assert "'tq'" in msgs[0] and "never parsed" in msgs[0]
+
+
+def test_protodrift_balanced_header_passes(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        DRIFT_SRC.format(consume_tq=', tq=kv.get("tq")'),
+        [proto_check()],
+    )
+    assert active(findings) == []
+
+
+def test_protodrift_dict_protocol_both_directions(tmp_path):
+    spec = ProtoSpec(
+        name="spec",
+        kind="dict",
+        producers=(("pkg/mod.py", "to_dict"),),
+        consumers=(("pkg/mod.py", "from_dict"),),
+    )
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def to_dict(s):
+            return {"layers": s.layers, "dtype": s.dtype}
+
+        def from_dict(d):
+            return (d["layers"], d["page_size"])
+        """,
+        [ProtoDriftCheck(protocols=(spec,), endian_modules=())],
+    )
+    msgs = [f.message for f in active(findings, "protodrift")]
+    assert len(msgs) == 2, msgs
+    assert any("'dtype'" in m and "never parsed" in m for m in msgs)
+    assert any("'page_size'" in m and "never emitted" in m for m in msgs)
+
+
+def test_protodrift_endianness_rules(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import struct
+        import numpy as np
+
+        def write_native(n):
+            return struct.pack("I", n)
+
+        def write_le(n):
+            return struct.pack("<I", n)
+
+        def read_le(buf):
+            return int(np.frombuffer(buf, np.dtype("<u4"))[0])
+
+        def read_be(buf):
+            return struct.unpack(">I", buf)[0]
+        """,
+        [ProtoDriftCheck(protocols=(), endian_modules=("pkg/mod.py",))],
+    )
+    msgs = [f.message for f in active(findings, "protodrift")]
+    # Native-order pack flags; "<I" pairs with "<u4"; the ">I" read has
+    # no big-endian writer.
+    assert len(msgs) == 2, msgs
+    assert any("no explicit byte order" in m for m in msgs)
+    assert any("no matching-endianness writer" in m for m in msgs)
+
+
+def test_protodrift_absent_protocol_modules_are_skipped(tmp_path):
+    # A protocol whose modules are outside the lint scope contributes
+    # nothing (fixture runs, subset lints).
+    findings = lint_snippet(
+        tmp_path, "x = 1\n", [ProtoDriftCheck()],
+    )
+    assert active(findings) == []
+
+
+# --- fingerprints + baseline diff ----------------------------------------
+
+
+def test_fingerprints_stable_across_line_shifts(tmp_path):
+    src = """
+    def swallow():
+        try:
+            work()
+        except Exception:
+            pass
+    """
+    f1 = active(lint_snippet(tmp_path, src, [BroadExceptCheck()]))
+    fp1 = assign_fingerprints(f1)[id(f1[0])]
+    (tmp_path / "pkg" / "mod.py").write_text(
+        "# a comment pushing everything down\n\n"
+        + textwrap.dedent(src)
+    )
+    files = load_files(str(tmp_path), ["pkg/mod.py"])
+    f2 = active(run_checks(files, [BroadExceptCheck()]))
+    fp2 = assign_fingerprints(f2)[id(f2[0])]
+    assert fp1 == fp2  # line moved, fingerprint did not
+
+
+def test_baseline_excludes_suppressed_results(tmp_path):
+    src = """
+    def swallow():
+        try:
+            work()
+        except Exception:  # sublint: allow[broad-except]: fixture
+            pass
+    """
+    findings = lint_snippet(tmp_path, src, [BroadExceptCheck()])
+    out = tmp_path / "base.sarif"
+    out.write_text(render_sarif(findings, [BroadExceptCheck()]))
+    fps, n_supp = baseline_fingerprints(str(out))
+    assert fps == set()  # suppressed results never whitelist anything
+    assert n_supp == 1
+
+
 # --- driver CLI -----------------------------------------------------------
 
 
@@ -536,7 +967,9 @@ def test_driver_cli_ast_only_exits_zero():
     proc = subprocess.run(
         [
             sys.executable, os.path.join(REPO_ROOT, "hack", "sublint.py"),
-            "--checks", "shard,hostsync,concurrency,broad-except",
+            "--checks",
+            "shard,hostsync,concurrency,broad-except,"
+            "lockorder,lifecycle,protodrift",
         ],
         capture_output=True, text=True, cwd=REPO_ROOT,
     )
@@ -554,8 +987,8 @@ def test_driver_cli_list_catalogs_every_family():
     )
     assert proc.returncode == 0
     for family in (
-        "shard", "hostsync", "concurrency", "broad-except", "metrics",
-        "trace", "suppression",
+        "shard", "hostsync", "concurrency", "broad-except", "lockorder",
+        "lifecycle", "protodrift", "metrics", "trace", "suppression",
     ):
         assert family in proc.stdout
 
@@ -572,3 +1005,84 @@ def test_driver_cli_sarif_file(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(out.read_text())
     assert doc["runs"][0]["tool"]["driver"]["name"] == "sublint"
+    for res in doc["runs"][0]["results"]:
+        assert res["partialFingerprints"]["sublint/v1"]
+
+
+# --- driver baseline-diff mode (the CI contract) --------------------------
+
+SWALLOW_ONE = """def one():
+    try:
+        work()
+    except Exception:
+        pass
+"""
+
+SWALLOW_TWO = SWALLOW_ONE + """
+
+def two():
+    try:
+        more()
+    except Exception:
+        pass
+"""
+
+
+def run_driver(root, *extra):
+    return subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "hack", "sublint.py"),
+            "--root", str(root), "--checks", "broad-except", *extra,
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+
+
+def seeded_tree(tmp_path, source=SWALLOW_ONE):
+    pkg = tmp_path / "substratus_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(source)
+    return tmp_path
+
+
+def test_baseline_diff_round_trip(tmp_path):
+    root = seeded_tree(tmp_path)
+    base = tmp_path / "base.sarif"
+    # Capture the baseline: the seeded finding fails a plain run but is
+    # recorded in the SARIF.
+    proc = run_driver(root, "--sarif", str(base))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    # Same tree + baseline: the old finding is reported but ignored.
+    proc = run_driver(root, "--baseline", str(base))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pre-existing finding(s) ignored" in proc.stdout
+    # A NEW finding fails even under the baseline, and is the only one
+    # called out.
+    seeded_tree(tmp_path, SWALLOW_TWO)
+    proc = run_driver(root, "--baseline", str(base))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "1 new unsuppressed finding(s)" in proc.stderr
+    assert "NEW" in proc.stderr and "two" not in proc.stdout
+
+
+def test_baseline_suppression_ratchet(tmp_path):
+    root = seeded_tree(tmp_path)
+    base = tmp_path / "base.sarif"
+    run_driver(root, "--sarif", str(base))
+    # Suppressing the finding makes the tree clean but trips the
+    # ratchet: the baseline recorded ZERO suppressions.
+    seeded_tree(
+        tmp_path,
+        SWALLOW_ONE.replace(
+            "except Exception:",
+            "except Exception:  # sublint: allow[broad-except]: fixture",
+        ),
+    )
+    proc = run_driver(root, "--baseline", str(base))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "suppression ratchet" in proc.stderr
+    # An explicit ceiling overrides the baseline-derived one.
+    proc = run_driver(
+        root, "--baseline", str(base), "--max-suppressions", "1"
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

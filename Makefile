@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench prefix-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -124,6 +124,16 @@ disagg-bench:
 # aggregate tok/s.
 prefix-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --prefix-reuse \
+	  | $(PY) hack/bench_compare.py --validate -
+
+# Batch-generation actor gang capture (ISSUE 9 acceptance): a 2-actor
+# gang draining one shared prompt manifest through the continuous-
+# refill driver vs one identical actor, simulated device step — gang
+# aggregate tok/s must reach >=1.8x single AND steady-state decode
+# slot occupancy >=0.9 (tests/test_batchgen.py asserts both; this
+# target validates the capture schema — docs/batch-generation.md).
+batchgen-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --batchgen 2 \
 	  | $(PY) hack/bench_compare.py --validate -
 
 # Bench JSON schema + >10% regression gate (hack/bench_compare.py):

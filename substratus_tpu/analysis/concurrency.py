@@ -43,6 +43,11 @@ DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     # (sender thread, per-channel readers, accept loop, the engine
     # scheduler calling ship()) — its _lock discipline stays enforced.
     "serve/disagg.py",
+    # The batch-generation driver: engine scheduler threads call
+    # _pull/_complete while the sink thread swaps the buffer and a
+    # sampler thread reads progress — every shared write rides
+    # self._lock (docs/batch-generation.md).
+    "serve/batchgen.py",
 )
 
 _BLOCKING = {

@@ -62,6 +62,18 @@ DEFAULT_RESOURCES: Tuple[ResourcePair, ...] = (
         receiver_hints=("adapter",),
         modules=("serve/engine.py", "serve/server.py"),
     ),
+    # Batch-generation output shards (serve/batchgen.py ShardWriter):
+    # an opened shard the sink thread never closes is a lost flush — the
+    # records in its user-space buffer would be regenerated on resume,
+    # but the driver would report them written. open_shard()/close()
+    # must balance in the driver.
+    ResourcePair(
+        name="shard-file",
+        open_suffixes=(".open_shard",),
+        close_suffixes=(".close",),
+        receiver_hints=("writer", "out"),
+        modules=("serve/batchgen.py",),
+    ),
 )
 
 # Threaded socket modules where the shutdown-before-close contract is

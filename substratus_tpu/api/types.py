@@ -93,7 +93,11 @@ class NotebookSpec:
 
 @dataclass
 class ServerSpec:
-    """Inference server (ref: server_types.go:10-31): `model` is required."""
+    """Inference server (ref: server_types.go:10-31): `model` is
+    required. `dataset` is only read by the batch-generation flavor
+    (`params.batchGenerate`, docs/batch-generation.md): the referenced
+    Dataset artifact mounts RO at /content/data and holds the prompt
+    manifest."""
 
     command: List[str] = field(default_factory=list)
     image: Optional[str] = None
@@ -102,6 +106,7 @@ class ServerSpec:
     env: Dict[str, str] = field(default_factory=dict)
     params: Dict[str, Any] = field(default_factory=dict)
     model: Optional[ObjectRef] = None
+    dataset: Optional[ObjectRef] = None
 
 
 def _object_class(kind: str, spec_cls: Type) -> Type:

@@ -414,6 +414,30 @@ def cmd_serve(args) -> int:
     return serve_main(argv)
 
 
+def cmd_batchgen(args) -> int:
+    """Run offline batch generation locally against a prompt manifest
+    (serve/batchgen.py). The cluster path is a Server CR whose
+    `params.batchGenerate` is set, submitted like any other CR with
+    `sub run`/`sub apply` — the controller renders it as a Job (or a
+    JobSet gang for multi-host slices); docs/batch-generation.md."""
+    from substratus_tpu.serve.batchgen import main as batchgen_main
+
+    argv = ["--manifest", args.manifest, "--output", args.output]
+    if args.model:
+        argv += ["--model", args.model]
+    if args.config:
+        argv += ["--config", args.config]
+    if args.max_tokens is not None:
+        argv += ["--max-tokens", str(args.max_tokens)]
+    if args.temperature is not None:
+        argv += ["--temperature", str(args.temperature)]
+    if args.no_resume:
+        argv += ["--no-resume"]
+    if args.progress_port is not None:
+        argv += ["--progress-port", str(args.progress_port)]
+    return batchgen_main(argv)
+
+
 def cmd_chat(args) -> int:
     """Interactive chat REPL (reference tui/infer_chat.go)."""
     from substratus_tpu.cli.chat import run_chat
@@ -631,6 +655,24 @@ def register(sub) -> None:
     p.add_argument("--config")
     p.add_argument("--port", type=int, default=8080)
     p.set_defaults(func=cmd_serve)
+
+    p = sub.add_parser(
+        "batchgen",
+        help="offline batch generation from a JSONL prompt manifest",
+    )
+    p.add_argument("--manifest", required=True,
+                   help="JSONL prompt manifest (docs/batch-generation.md)")
+    p.add_argument("--output", required=True,
+                   help="output shard directory (also the resume ledger)")
+    p.add_argument("--model", help="checkpoint dir")
+    p.add_argument("--config", help="named config for weightless smoke runs")
+    p.add_argument("--max-tokens", type=int, default=None)
+    p.add_argument("--temperature", type=float, default=None)
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore existing output shards")
+    p.add_argument("--progress-port", type=int, default=None,
+                   help="serve /loadz + /metrics while running")
+    p.set_defaults(func=cmd_batchgen)
 
     p = sub.add_parser(
         "chat", help="interactive chat with a served model"

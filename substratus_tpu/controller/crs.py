@@ -43,6 +43,7 @@ DEFAULT_RUNTIME_IMAGE = "ghcr.io/substratus-tpu/runtime:latest"
 LOADER_COMMAND = ["python", "-m", "substratus_tpu.load.main"]
 TRAINER_COMMAND = ["python", "-m", "substratus_tpu.train.main"]
 SERVER_COMMAND = ["python", "-m", "substratus_tpu.serve.main"]
+BATCHGEN_COMMAND = ["python", "-m", "substratus_tpu.serve.batchgen"]
 NOTEBOOK_COMMAND = [
     "jupyter", "lab", "--ip=0.0.0.0", "--port=8888", "--allow-root",
     "--no-browser", "--notebook-dir=/content",
@@ -360,6 +361,10 @@ class ServerReconciler(BaseReconciler):
     def __call__(self, obj: Obj) -> Result:
         if not self.image_gate(obj):
             return Result()
+        if ((obj.get("spec") or {}).get("params") or {}).get(
+            "batchGenerate"
+        ):
+            return self._reconcile_batchgen(obj)
         if ((obj.get("spec") or {}).get("params") or {}).get("baseModel"):
             return self._reconcile_shared(obj)
         reconcile_child(self.client, params_configmap(obj))
@@ -482,6 +487,70 @@ class ServerReconciler(BaseReconciler):
             C.REASON_DEPLOYMENT_READY if ready else C.REASON_DEPLOYMENT_NOT_READY,
         )
         write_status(self.client, obj)
+        return Result()
+
+    def _reconcile_batchgen(self, obj: Obj) -> Result:
+        """Batch-generation flavor (ROADMAP item 5, serve/batchgen.py,
+        docs/batch-generation.md): a Server whose `params.batchGenerate`
+        is set runs to COMPLETION instead of serving — a Job on a single
+        host, or the same JobSet gang shape a multi-host lockstep Server
+        gets (headless rendezvous Service + TPU_WORKER_*/JAX coordinator
+        env) when the resources ask for a multi-host slice. Mounts:
+        model RO at /content/model, the manifest Dataset RO at
+        /content/data, this CR's artifact bucket RW at /content/artifacts
+        (the output-shard home). Status follows the Job like a Model
+        import: Complete condition + ready on completion."""
+        if obj.get("status", {}).get("ready") and condition_true(
+            obj, C.CONDITION_COMPLETE
+        ):
+            return Result()
+        reconcile_child(self.client, params_configmap(obj))
+        url = self.stamp_artifacts_url(obj)
+        ns = obj["metadata"]["namespace"]
+        reconcile_service_account(
+            self.client, self.cloud, self.sci, ns, SA_MODEL_SERVER
+        )
+
+        model, park = self.resolve_ref(
+            obj, "model", "Model", C.CONDITION_COMPLETE,
+            C.REASON_MODEL_NOT_FOUND, C.REASON_MODEL_NOT_READY,
+        )
+        if park:
+            return park
+        dataset, park = self.resolve_ref(
+            obj, "dataset", "Dataset", C.CONDITION_COMPLETE,
+            C.REASON_DATASET_NOT_FOUND, C.REASON_DATASET_NOT_READY,
+        )
+        if park:
+            return park
+
+        mounts: Dict[str, tuple] = {
+            "artifacts": (url, {"artifacts": "/content/artifacts"}, False)
+        }
+        if model is not None:
+            mounts["model"] = (
+                self.artifact_url_of(model),
+                {"artifacts": "/content/model"}, True,
+            )
+        if dataset is not None:
+            mounts["data"] = (
+                self.artifact_url_of(dataset),
+                {"artifacts": "/content/data"}, True,
+            )
+        container = build_container(
+            obj, self.cloud, artifact_mounts={},
+            default_command=BATCHGEN_COMMAND,
+        )
+        pod = build_pod(
+            obj, self.cloud,
+            name=f"{obj['metadata']['name']}-batchgen",
+            sa_name=SA_MODEL_SERVER,
+            container=container,
+            mounts=mounts,
+        )
+        workloads = workload_for_pod(obj, pod, self.backoff_limit(obj))
+        live = [reconcile_child(self.client, w) for w in workloads]
+        self.finish_from_workload(obj, live[-1], C.CONDITION_COMPLETE)
         return Result()
 
     def _reconcile_disaggregated(self, obj: Obj, pod, disagg) -> Result:

@@ -492,6 +492,13 @@ class Engine:
             self.draft_cache = draft_pool
 
         self.queue: "queue.Queue[Request]" = queue.Queue()
+        # Pull-based admission fast-path (serve/batchgen.py): when set,
+        # the scheduler thread pulls the next request DIRECTLY from the
+        # source the moment a slot frees — no submit() thread handoff,
+        # no queue-wait round trip — which is what keeps an offline
+        # batch-generation run's decode batch permanently full. The
+        # queue path stays live alongside it (sources only top up).
+        self.source = None
         # Migrated-request admission (serve/disagg.py): the HandoffServer
         # enqueues from its connection threads; only the scheduler thread
         # consumes. Held-back migrations (pool dry / adapter pinned) wait
@@ -864,6 +871,27 @@ class Engine:
             mig.req.finish_reason = "error"
             mig.req.out.put(None)
 
+    def set_source(self, source) -> None:
+        """Attach (or detach, with None) a pull-based request source —
+        the batch-generation admission fast-path. The source's pull()
+        runs on the SCHEDULER thread (on the lockstep leader: inside
+        _sync_iterate, so pulled requests broadcast like submitted
+        ones); it must return a fully-formed Request (with an out sink)
+        or None, and pending() must say whether pull() could yield.
+        Sources are consulted after the resume list and the submit()
+        queue, so interactive traffic always boards first."""
+        if source is not None and self.ec.role == "decode":
+            raise RuntimeError(
+                "decode-role engine: requests arrive as KV migrations, "
+                "not from a pull source"
+            )
+        if source is not None and self.sync is not None and not self.sync.leader:
+            raise RuntimeError(
+                "follower engine: the leader owns the source; followers "
+                "receive pulled requests via the broadcast"
+            )
+        self.source = source
+
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -885,12 +913,21 @@ class Engine:
         try:
             return self.queue.get_nowait()
         except queue.Empty:
-            return None
+            pass
+        if self.source is not None:
+            # Continuous refill: the freed slot's replacement boards in
+            # this same scheduler iteration, straight off the source.
+            return self.source.pull()
+        return None
 
     def _has_pending(self) -> bool:
         if self.sync is not None:
             return bool(self._resume) or bool(self._synced)
-        return bool(self._resume) or not self.queue.empty()
+        return (
+            bool(self._resume)
+            or not self.queue.empty()
+            or (self.source is not None and self.source.pending())
+        )
 
     def _is_cancelled(self, req: Request) -> bool:
         """Lockstep mode reads the broadcast latch (identical on every
@@ -915,6 +952,23 @@ class Engine:
                     new.append(self.queue.get_nowait())
                 except queue.Empty:
                     break
+            if self.source is not None:
+                # Pull-source refill rides the same broadcast as
+                # submitted requests: the leader tops the gang up to its
+                # free slot budget and every process admits identically.
+                budget = (
+                    self.ec.max_batch
+                    - int(self.active.sum())  # sublint: allow[hostsync]: host numpy mirror of the active mask, no device read
+                    - len(self._synced)
+                    - len(self._resume)
+                    - len(new)
+                )
+                while budget > 0:
+                    r = self.source.pull()
+                    if r is None:
+                        break
+                    new.append(r)
+                    budget -= 1
             for r in new:
                 self._sync_seq += 1
                 r.sync_id = self._sync_seq
@@ -965,10 +1019,15 @@ class Engine:
         admitted = self._admit_migrations()
         # No in-flight decodes -> nothing to starve: fill freely (decode
         # steps cost the same at any occupancy, so boarding everyone first
-        # is strictly better for TTFT).
+        # is strictly better for TTFT). A pull source (batch generation,
+        # serve/batchgen.py) also fills freely: the cap exists to protect
+        # in-flight streams' inter-token latency, and an offline run's
+        # only objective is keeping every slot busy — throttling refill
+        # to one slot per iteration just leaves slots idle for a step
+        # after a synchronized completion wave.
         cap = (
             max(1, self.ec.max_batch // 4)
-            if self.active.any()
+            if self.active.any() and self.source is None
             else self.ec.max_batch
         )
         while (
@@ -1422,6 +1481,7 @@ class Engine:
 
     def _decode_step(self) -> None:
         """One plain decode iteration: every active slot advances a token."""
+        t_step = time.perf_counter()
         if self.paged:
             # Grow every slot that will cross a page boundary this step
             # (may preempt or, at the limit, truncate).
@@ -1443,6 +1503,16 @@ class Engine:
             adapter_ids,
         )
         self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side so lockstep processes feed identical replicated inputs
+        # The simulated device-step floor lands BEFORE the host read and
+        # the emits: on a real accelerator tokens only exist once the
+        # device step finishes, so a slot freed by an emit is admissible
+        # in the very next iteration with no artificial dead time (the
+        # batchgen continuous-refill occupancy measures exactly this).
+        # _loop's own floor check then sees dt >= floor and never
+        # double-sleeps.
+        dt_step = time.perf_counter() - t_step
+        if self.ec.step_floor_s > dt_step:
+            time.sleep(self.ec.step_floor_s - dt_step)
         # Clamp at the last cache row: active slots are released at the
         # window before reaching it (_emit's hit_window), so the clamp only
         # catches INACTIVE slots, whose positions otherwise drift past the
@@ -1509,6 +1579,7 @@ class Engine:
         verify pass's position-0 sample. Cache staleness beyond the
         accepted point is safe: causal masking never reads past the query
         position, and the next round rewrites exactly those slots."""
+        t_step = time.perf_counter()
         k = self.ec.spec_k
         # Speculation only pays off for greedy slots; an all-sampling batch
         # would do k draft steps + a (k+1)-wide verify to emit one token
@@ -1555,6 +1626,12 @@ class Engine:
         self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side (lockstep replication contract)
         self.stats["verify_passes"] += 1
 
+        # Same floor placement as _decode_step: simulated device latency
+        # precedes the host read + emits, so freed slots carry no
+        # artificial post-emit dead time.
+        dt_step = time.perf_counter() - t_step
+        if self.ec.step_floor_s > dt_step:
+            time.sleep(self.ec.step_floor_s - dt_step)
         chs = np.asarray(choices)  # sublint: allow[hostsync]: THE per-spec-round host read — acceptance walk + emit need the verify output
         smp = np.asarray(sampled)  # sublint: allow[hostsync]: same read as chs; one transfer per speculative round
         next_tokens = self.tokens.copy()
@@ -1795,6 +1872,12 @@ class Engine:
             "prefill_tokens": self.stats["prefill_tokens"],
             "prefix_hit_tokens": self.stats["prefix_hit_tokens"],
         }
+        src = self.source
+        if src is not None and hasattr(src, "progress"):
+            # Batch-generation progress (serve/batchgen.py): manifest
+            # totals + done/in-flight counts, so /loadz answers for an
+            # offline run when its progress server is enabled.
+            snap["batchgen"] = src.progress()
         if self.adapters is not None:
             # Resident adapter ids + hit/miss/evict counters: the
             # gateway's affinity scoring reads `adapters` (loadreport.py

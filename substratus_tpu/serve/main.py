@@ -20,7 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 
@@ -58,6 +58,90 @@ def resolve_kv_layout(params_json: Dict[str, Any]) -> str:
             "(the paged decode path does not use the fused kernel)"
         )
     return layout
+
+
+def load_checkpoint(path: str):
+    """One resolution rule for target and draft models alike (shared
+    with the batch-generation entrypoint, serve/batchgen.py): a .gguf
+    file (or a mounted artifact dir holding one) loads through the
+    llama.cpp-format importer; otherwise orbax artifact if present,
+    else HF layout."""
+    gguf_path = _resolve_gguf(path)
+    if gguf_path is not None:
+        from substratus_tpu.load.gguf import load_gguf
+
+        return load_gguf(gguf_path)
+    from substratus_tpu.train.checkpoints import maybe_restore_orbax
+
+    restored = maybe_restore_orbax(path)
+    if restored is not None:
+        return restored
+    from substratus_tpu.load.hf import load_pretrained
+
+    return load_pretrained(path)
+
+
+def build_adapter_store(family, cfg, params_json: Dict[str, Any],
+                        adapters_dir_flag: Optional[str]):
+    """Multi-tenant AdapterStore from params/--adapters-dir discovery
+    (docs/serving.md "Multi-tenant adapters"), shared by the interactive
+    server and the batch-generation driver so a manifest's per-record
+    `model` field selects the same LoRA slots a chat request would.
+    Returns None when no adapters are configured (or the family can't
+    index them — loud, not silent)."""
+    adapters_cfg = params_json.get("adapters") or {}
+    adapters_dir = adapters_dir_flag or adapters_cfg.get("dir") or (
+        "/content/adapters" if os.path.isdir("/content/adapters") else None
+    )
+    if not adapters_dir and not adapters_cfg.get("paths"):
+        return None
+    if not getattr(family, "SUPPORTS_INDEXED_LORA", False):
+        # Same loud-not-silent policy as _maybe_quantize: tell the
+        # operator their tenants won't be served instead of 404ing
+        # every adapter request with no explanation in the logs.
+        print(
+            "multi-tenant adapters unsupported for this family; "
+            "serving the base model only",
+            flush=True,
+        )
+        return None
+    from substratus_tpu.serve.adapters import (
+        AdapterStore, infer_store_shape, is_adapter_artifact,
+    )
+
+    explicit = dict(adapters_cfg.get("paths") or {})
+    discovered = {}
+    if adapters_dir and os.path.isdir(adapters_dir):
+        for entry in sorted(os.listdir(adapters_dir)):
+            p = os.path.join(adapters_dir, entry)
+            if is_adapter_artifact(p):
+                discovered[entry] = p
+    inferred_rank, inferred_targets = infer_store_shape(
+        list(explicit.values()) + list(discovered.values())
+    )
+    adapters = AdapterStore(
+        cfg,
+        capacity=int(adapters_cfg.get("capacity", 8)),
+        rank=int(adapters_cfg.get("rank", inferred_rank)),
+        targets=tuple(adapters_cfg.get("targets", inferred_targets)),
+        search_dir=adapters_dir,
+    )
+    for aid, p in explicit.items():
+        adapters.register_path(aid, p)
+    # Preload up to capacity so first requests don't pay the
+    # artifact read; the rest hot-load on demand (cache miss).
+    for aid in list(adapters.available_ids())[: adapters.capacity]:
+        try:
+            adapters.load(aid)
+        except (OSError, ValueError) as e:
+            print(f"adapter {aid!r} failed to preload: {e}", flush=True)
+    print(
+        f"adapter store: {len(adapters.loaded_ids())} loaded / "
+        f"{len(adapters.available_ids())} available "
+        f"(capacity {adapters.capacity}, rank {adapters.rank})",
+        flush=True,
+    )
+    return adapters
 
 
 def _maybe_quantize(family, cfg, params, quantize: str, quiet: bool = False):
@@ -164,7 +248,7 @@ def main(argv=None) -> int:
             "chunk_attn_impl", "decode_attn_impl", "q4_impl", "tensor",
             "sequence", "replicas", "draft_model", "spec_k", "max_queue",
             "drain_grace", "adapters", "baseModel", "disaggregated",
-            "role", "transfer_port", "decode_peers",
+            "role", "transfer_port", "decode_peers", "batchGenerate",
         ),
         "serve.main",
     )
@@ -179,25 +263,6 @@ def main(argv=None) -> int:
     from substratus_tpu.serve.engine import Engine, EngineConfig
     from substratus_tpu.serve.server import ServerState, serve_forever
     from substratus_tpu.serve.tokenizer import load_tokenizer
-
-    def load_checkpoint(path: str):
-        """One resolution rule for target and draft models alike: a .gguf
-        file (or a mounted artifact dir holding one) loads through the
-        llama.cpp-format importer; otherwise orbax artifact if present,
-        else HF layout."""
-        gguf_path = _resolve_gguf(path)
-        if gguf_path is not None:
-            from substratus_tpu.load.gguf import load_gguf
-
-            return load_gguf(gguf_path)
-        from substratus_tpu.train.checkpoints import maybe_restore_orbax
-
-        restored = maybe_restore_orbax(path)
-        if restored is not None:
-            return restored
-        from substratus_tpu.load.hf import load_pretrained
-
-        return load_pretrained(path)
 
     if model_dir:
         cfg, params = load_checkpoint(model_dir)
@@ -383,62 +448,10 @@ def main(argv=None) -> int:
     # adapters"): pack N tenants' LoRA adapters into this one engine.
     # Sources: --adapters-dir / params.json {"adapters": {"dir": ...,
     # "paths": {id: path}, "capacity", "rank", "targets"}}, defaulting
-    # to the container-contract /content/adapters mount when present.
-    adapters = None
-    adapters_cfg = params_json.get("adapters") or {}
-    adapters_dir = args.adapters_dir or adapters_cfg.get("dir") or (
-        "/content/adapters" if os.path.isdir("/content/adapters") else None
-    )
-    if adapters_dir or adapters_cfg.get("paths"):
-        if not getattr(family, "SUPPORTS_INDEXED_LORA", False):
-            # Same loud-not-silent policy as _maybe_quantize: tell the
-            # operator their tenants won't be served instead of 404ing
-            # every adapter request with no explanation in the logs.
-            print(
-                "multi-tenant adapters unsupported for this family; "
-                "serving the base model only",
-                flush=True,
-            )
-        else:
-            from substratus_tpu.serve.adapters import (
-                AdapterStore, infer_store_shape, is_adapter_artifact,
-            )
-
-            explicit = dict(adapters_cfg.get("paths") or {})
-            discovered = {}
-            if adapters_dir and os.path.isdir(adapters_dir):
-                for entry in sorted(os.listdir(adapters_dir)):
-                    p = os.path.join(adapters_dir, entry)
-                    if is_adapter_artifact(p):
-                        discovered[entry] = p
-            inferred_rank, inferred_targets = infer_store_shape(
-                list(explicit.values()) + list(discovered.values())
-            )
-            adapters = AdapterStore(
-                cfg,
-                capacity=int(adapters_cfg.get("capacity", 8)),
-                rank=int(adapters_cfg.get("rank", inferred_rank)),
-                targets=tuple(
-                    adapters_cfg.get("targets", inferred_targets)
-                ),
-                search_dir=adapters_dir,
-            )
-            for aid, p in explicit.items():
-                adapters.register_path(aid, p)
-            # Preload up to capacity so first requests don't pay the
-            # artifact read; the rest hot-load on demand (cache miss).
-            for aid in list(adapters.available_ids())[: adapters.capacity]:
-                try:
-                    adapters.load(aid)
-                except (OSError, ValueError) as e:
-                    print(f"adapter {aid!r} failed to preload: {e}",
-                          flush=True)
-            print(
-                f"adapter store: {len(adapters.loaded_ids())} loaded / "
-                f"{len(adapters.available_ids())} available "
-                f"(capacity {adapters.capacity}, rank {adapters.rank})",
-                flush=True,
-            )
+    # to the container-contract /content/adapters mount when present
+    # (build_adapter_store — shared with serve/batchgen.py).
+    adapters = build_adapter_store(family, cfg, params_json,
+                                   args.adapters_dir)
 
     # Disaggregated prefill/decode serving (serve/disagg.py, ROADMAP
     # item 3). Per-tier values arrive as env vars (the controller stamps

@@ -1137,3 +1137,156 @@ def test_apply_conflict_retry_two_writers():
     assert live["metadata"]["labels"]["from-b"] == "true"  # B's label kept
     assert live["metadata"]["labels"]["from-a"] == "true"
     assert out["metadata"]["resourceVersion"] == live["metadata"]["resourceVersion"]
+
+
+def test_server_batchgen_renders_completion_job(env):
+    """`params.batchGenerate` flips a Server into the batch-generation
+    flavor (ISSUE 9, serve/batchgen.py): a Job — not a Deployment, not a
+    Service — running the batchgen entrypoint with the model RO at
+    /content/model, the manifest Dataset RO at /content/data, the CR's
+    artifact bucket RW (the output-shard home), and the deterministic
+    TRACEPARENT stamped. Status follows the Job: ready only on
+    completion, with the Complete condition."""
+    from substratus_tpu.controller.workloads import workload_traceparent
+    from substratus_tpu.kube.client import NotFound
+
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    client.create(_dataset(name="prompts"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    client.mark_job_complete("default", "prompts-data-loader")
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "bg", "namespace": "default"},
+            "spec": {
+                "image": "img:bg",
+                "model": {"name": "base"},
+                "dataset": {"name": "prompts"},
+                "params": {
+                    "batchGenerate": {
+                        "manifest": "/content/data/prompts.jsonl",
+                        "maxTokens": 128,
+                    }
+                },
+            },
+        }
+    )
+    mgr.run_until_idle()
+
+    job = client.get("Job", "default", "bg-batchgen")
+    tmpl = job["spec"]["template"]["spec"]
+    c = tmpl["containers"][0]
+    assert c["command"] == ["python", "-m", "substratus_tpu.serve.batchgen"]
+    env_by_name = {e["name"]: e.get("value") for e in c["env"]}
+    srv = client.get("Server", "default", "bg")
+    assert env_by_name["TRACEPARENT"] == workload_traceparent(srv)
+    assert "PARAM_BATCHGENERATE" in env_by_name
+    mounts = {m["mountPath"] for m in c["volumeMounts"]}
+    assert {"/content/model", "/content/data", "/content/artifacts",
+            "/content/params.json"} <= mounts
+
+    # Batch flavor replaces the serving shape entirely.
+    with pytest.raises(NotFound):
+        client.get("Deployment", "default", "bg-server")
+    with pytest.raises(NotFound):
+        client.get("Service", "default", "bg-server")
+
+    conds = {c["type"]: c for c in srv["status"]["conditions"]}
+    assert srv["status"]["ready"] is False
+    assert conds["Complete"]["reason"] == "JobNotComplete"
+
+    client.mark_job_complete("default", "bg-batchgen")
+    mgr.run_until_idle()
+    srv = client.get("Server", "default", "bg")
+    conds = {c["type"]: c for c in srv["status"]["conditions"]}
+    assert srv["status"]["ready"] is True
+    assert conds["Complete"]["reason"] == "JobComplete"
+
+
+def test_server_batchgen_multihost_renders_jobset_gang(env):
+    """A batch-generation Server asking for a multi-host TPU slice
+    renders the JobSet gang shape (headless rendezvous Service +
+    indexed Jobs with the TPU_WORKER_*/JAX coordinator env) so the
+    lockstep engine spans hosts — with the deterministic TRACEPARENT on
+    every worker and completion-tracked status like the single-host
+    Job."""
+    from substratus_tpu.controller.workloads import workload_traceparent
+
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    client.create(_dataset(name="prompts"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    client.mark_job_complete("default", "prompts-data-loader")
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "bgang", "namespace": "default"},
+            "spec": {
+                "image": "img:bg",
+                "model": {"name": "base"},
+                "dataset": {"name": "prompts"},
+                "params": {"batchGenerate": True},
+                "resources": {
+                    "tpu": {"type": "v5e", "chips": 16, "topology": "4x4"}
+                },
+            },
+        }
+    )
+    mgr.run_until_idle()
+
+    js = client.get("JobSet", "default", "bgang-batchgen")
+    job_tmpl = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job_tmpl["completions"] == 4 and job_tmpl["parallelism"] == 4
+    assert job_tmpl["completionMode"] == "Indexed"
+    c = job_tmpl["template"]["spec"]["containers"][0]
+    assert c["command"] == ["python", "-m", "substratus_tpu.serve.batchgen"]
+    env_by_name = {e["name"]: e.get("value") for e in c["env"]}
+    assert {"TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES"} <= set(
+        env_by_name
+    )
+    srv = client.get("Server", "default", "bgang")
+    assert env_by_name["TRACEPARENT"] == workload_traceparent(srv)
+    headless = client.get("Service", "default", "bgang-batchgen")
+    assert headless["spec"]["clusterIP"] == "None"
+
+    assert srv["status"]["ready"] is False
+    client.mark_jobset_complete("default", "bgang-batchgen")
+    mgr.run_until_idle()
+    srv = client.get("Server", "default", "bgang")
+    assert srv["status"]["ready"] is True
+
+
+def test_server_batchgen_parks_on_missing_dataset(env):
+    """The manifest Dataset gates the Job exactly like a finetune's
+    corpus: missing -> DatasetNotFound, never a half-mounted Job."""
+    client, cloud, sci, mgr = env
+    client.create(_model(name="base"))
+    mgr.run_until_idle()
+    client.mark_job_complete("default", "base-modeller")
+    client.create(
+        {
+            "apiVersion": "substratus.ai/v1",
+            "kind": "Server",
+            "metadata": {"name": "bgp", "namespace": "default"},
+            "spec": {
+                "image": "img:bg",
+                "model": {"name": "base"},
+                "dataset": {"name": "missing"},
+                "params": {"batchGenerate": True},
+            },
+        }
+    )
+    mgr.run_until_idle()
+    from substratus_tpu.kube.client import NotFound
+
+    with pytest.raises(NotFound):
+        client.get("Job", "default", "bgp-batchgen")
+    srv = client.get("Server", "default", "bgp")
+    conds = {c["type"]: c for c in srv["status"]["conditions"]}
+    assert conds["Complete"]["reason"] == "DatasetNotFound"

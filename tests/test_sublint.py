@@ -805,6 +805,68 @@ def test_lifecycle_socket_scope_is_module_gated(tmp_path):
     assert active(findings) == []
 
 
+def test_lifecycle_shard_file_pair_covers_batchgen(tmp_path):
+    """The PR 9 shard-file contract (serve/batchgen.py ShardWriter):
+    an open_shard() on a writer-ish receiver with no close() anywhere
+    in the driver module flags; the real open/close-in-finally shape
+    passes. Same DEFAULT_RESOURCES pair, narrowed to a fixture path."""
+    from substratus_tpu.analysis.lifecycle import DEFAULT_RESOURCES
+
+    shard_pair = next(p for p in DEFAULT_RESOURCES if p.name == "shard-file")
+    check = LifecycleCheck(
+        resources=(
+            ResourcePair(
+                name=shard_pair.name,
+                open_suffixes=shard_pair.open_suffixes,
+                close_suffixes=shard_pair.close_suffixes,
+                receiver_hints=shard_pair.receiver_hints,
+                modules=("pkg/mod.py",),
+            ),
+        ),
+        socket_modules=(),
+    )
+    leaky = lint_snippet(
+        tmp_path,
+        """
+        def run(self):
+            path = self._writer.open_shard()
+            drive(path)
+        """,
+        [check],
+    )
+    msgs = [f.message for f in active(leaky, "lifecycle")]
+    assert len(msgs) == 1 and "never calls" in msgs[0], msgs
+
+    balanced = lint_snippet(
+        tmp_path,
+        """
+        def run(self):
+            path = self._writer.open_shard()
+            try:
+                drive(path)
+            finally:
+                self._writer.close()
+        """,
+        [check],
+    )
+    assert active(balanced) == []
+
+
+def test_concurrency_shared_attr_scope_includes_batchgen():
+    """PR 9 coverage pin: the batchgen driver's sink/sampler threads
+    fall under the shared-attr lock discipline like the engine."""
+    from substratus_tpu.analysis.concurrency import (
+        DEFAULT_SHARED_ATTR_MODULES,
+    )
+    from substratus_tpu.analysis.lifecycle import DEFAULT_RESOURCES
+
+    assert "serve/batchgen.py" in DEFAULT_SHARED_ATTR_MODULES
+    assert any(
+        "serve/batchgen.py" in p.modules and p.name == "shard-file"
+        for p in DEFAULT_RESOURCES
+    )
+
+
 # --- protodrift -----------------------------------------------------------
 
 DRIFT_SRC = """

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench prefix-bench batchgen-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench overlap-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -117,6 +117,17 @@ adapter-bench:
 # (docs/serving.md "Disaggregated prefill/decode").
 disagg-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --disagg \
+	  | $(PY) hack/bench_compare.py --validate -
+
+# Overlapped decode scheduler capture (ISSUE 10 acceptance): one-step-
+# ahead dispatch with on-device token feedback vs the synchronous
+# scheduler on the same shape, simulated device step + real per-token
+# detokenize host work in the emit path — steady-state inter-token
+# mean must hold <= 1.15x the device floor with aggregate tok/s within
+# 5% or better, greedy outputs token-exact (tests/test_overlap.py
+# asserts; docs/performance.md "Overlapped scheduling").
+overlap-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --overlap \
 	  | $(PY) hack/bench_compare.py --validate -
 
 # Shared-prefix KV reuse capture (ROADMAP item 1 evidence): repeated

@@ -22,6 +22,16 @@ tokens, telemetry flush points — carry
 documented at its site. Reachability is intra-module (self.method and
 module-function edges); jitted bodies built outside the loop are
 correctly out of scope.
+
+Deferred-read idiom (the overlapped scheduler, serve/engine.py): the
+engine's decode step is split into ``Engine._dispatch`` (device-only —
+capacity growth, on-device token feedback, the jitted launch) and
+``Engine._drain`` (the ONE deferred host read plus emits, run while the
+next step occupies the device). The allowed host read therefore lives
+in ``_drain``; any sync reachable from a STALL_ROOTS entry
+(``_dispatch``) is reported as a *pipeline stall* — it would block the
+launch path on device completion and re-serialize the one-step-ahead
+pipeline, which is strictly worse than a sync elsewhere in the loop.
 """
 from __future__ import annotations
 
@@ -34,6 +44,21 @@ DEFAULT_ROOTS: Tuple[Tuple[str, str], ...] = (
     ("serve/engine.py", "Engine._loop"),
     ("train/trainer.py", "Trainer.train_step"),
 )
+
+# Dispatch-side roots of the deferred-read split: a host sync reachable
+# from one of these is a pipeline stall (the launch path must stay
+# async; the matching drain owns the one deferred read). Checked as
+# roots in their own right — the stall report survives even if the
+# loop-root edge to the dispatch half is ever refactored away.
+STALL_ROOTS: Tuple[Tuple[str, str], ...] = (
+    ("serve/engine.py", "Engine._dispatch"),
+)
+
+# The stall walk stops at explicit pipeline-flush methods: a flush IS a
+# deliberate, metered stall (substratus_serve_pipeline_flushes_total),
+# and the deferred read it drains through is the hot loop's accepted
+# sync — only syncs on the launch path itself re-serialize every step.
+STALL_BOUNDARIES: Tuple[str, ...] = ("_flush",)
 
 _SYNC_DOTTED = {
     "jax.device_get": "jax.device_get() copies device buffers to host",
@@ -82,10 +107,12 @@ def _callees(
 
 
 def reachable_from(
-    tree: ast.Module, root: str
+    tree: ast.Module, root: str, prune: Sequence[str] = ()
 ) -> Optional[Dict[str, ast.AST]]:
     """BFS closure of the intra-module call graph from `root`
-    ("Class.method" or "function"). None when the root doesn't exist."""
+    ("Class.method" or "function"). None when the root doesn't exist.
+    `prune` names methods/functions the walk never enters (boundary
+    functions whose bodies are accounted separately)."""
     index = _index_functions(tree)
     if root not in index:
         return None
@@ -94,9 +121,10 @@ def reachable_from(
     while frontier:
         cur = frontier.pop()
         for nxt in _callees(cur, index[cur], index):
-            if nxt not in seen:
-                seen.add(nxt)
-                frontier.append(nxt)
+            if nxt in seen or nxt.rsplit(".", 1)[-1] in prune:
+                continue
+            seen.add(nxt)
+            frontier.append(nxt)
     return {q: index[q] for q in seen}
 
 
@@ -131,16 +159,86 @@ class HostSyncCheck(Check):
         "engine decode loop and the trainer step"
     )
 
-    def __init__(self, roots: Sequence[Tuple[str, str]] = DEFAULT_ROOTS):
+    def __init__(
+        self,
+        roots: Sequence[Tuple[str, str]] = DEFAULT_ROOTS,
+        stall_roots: Sequence[Tuple[str, str]] = STALL_ROOTS,
+    ):
         self.roots = tuple(roots)
+        self.stall_roots = tuple(stall_roots)
+
+    @staticmethod
+    def _find_sf(files: Dict[str, SourceFile], suffix: str):
+        return next(
+            (s for r, s in sorted(files.items()) if r.endswith(suffix)),
+            None,
+        )
 
     def run(self, files: Dict[str, SourceFile]) -> List[Finding]:
         out: List[Finding] = []
-        for suffix, root in self.roots:
-            sf = next(
-                (s for r, s in sorted(files.items()) if r.endswith(suffix)),
-                None,
+        seen = set()  # (path, line, col) — stall findings take priority
+
+        def emit(sf, node, text):
+            key = (sf.rel, node.lineno, node.col_offset + 1)
+            if key in seen:
+                return
+            seen.add(key)
+            out.append(
+                Finding(
+                    check="hostsync", path=sf.rel,
+                    line=node.lineno, col=node.col_offset + 1,
+                    message=text,
+                )
             )
+
+        def walk(sf, root, reach, stall: bool):
+            for qual, fn in sorted(reach.items()):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    why = _classify_sync(node)
+                    if why is None:
+                        continue
+                    if stall:
+                        emit(
+                            sf, node,
+                            f"{why} — PIPELINE STALL: reachable from "
+                            f"{root}, the device-only dispatch half of "
+                            "the overlapped scheduler; the one deferred "
+                            "host read belongs in the matching drain() "
+                            "(docs/performance.md \"Overlapped "
+                            "scheduling\")",
+                        )
+                    else:
+                        emit(
+                            sf, node,
+                            f"{why} (in {qual}, reachable from the "
+                            f"{root} hot loop)",
+                        )
+
+        # Stall roots first: a sync inside the dispatch half is the
+        # worse defect, so its report wins the per-site dedupe.
+        for suffix, root in self.stall_roots:
+            sf = self._find_sf(files, suffix)
+            if sf is None or sf.tree is None:
+                continue  # module not in the lint scope (fixture runs)
+            reach = reachable_from(sf.tree, root, prune=STALL_BOUNDARIES)
+            if reach is None:
+                out.append(
+                    Finding(
+                        check="hostsync", path=sf.rel, line=1, col=1,
+                        message=(
+                            f"dispatch root {root!r} not found — update "
+                            "analysis/hostsync.py STALL_ROOTS after "
+                            "renaming the overlapped scheduler's "
+                            "dispatch half"
+                        ),
+                    )
+                )
+                continue
+            walk(sf, root, reach, stall=True)
+        for suffix, root in self.roots:
+            sf = self._find_sf(files, suffix)
             if sf is None or sf.tree is None:
                 continue  # module not in the lint scope (fixture runs)
             reach = reachable_from(sf.tree, root)
@@ -156,21 +254,5 @@ class HostSyncCheck(Check):
                     )
                 )
                 continue
-            for qual, fn in sorted(reach.items()):
-                for node in ast.walk(fn):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    why = _classify_sync(node)
-                    if why is None:
-                        continue
-                    out.append(
-                        Finding(
-                            check="hostsync", path=sf.rel,
-                            line=node.lineno, col=node.col_offset + 1,
-                            message=(
-                                f"{why} (in {qual}, reachable from the "
-                                f"{root} hot loop)"
-                            ),
-                        )
-                    )
+            walk(sf, root, reach, stall=False)
         return out

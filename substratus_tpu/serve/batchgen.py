@@ -12,9 +12,18 @@ bursts; this driver measures nothing but chips-saturated tokens/sec:
     batches pack into the one compiled program;
   * **continuous refill** — the engines take requests through the pull
     source fast-path (Engine.set_source): the scheduler thread pulls the
-    next prompt the moment a slot frees, in the same iteration — no
-    submit() thread handoff, no queue-wait round trip — which is what
-    holds decode occupancy at ~1.0 for the whole run;
+    next prompt the moment a slot frees — no submit() thread handoff,
+    no queue-wait round trip — which is what holds decode occupancy
+    near 1.0 for the whole run. Under the overlapped scheduler (the
+    default since round 10, docs/performance.md "Overlapped
+    scheduling") a completion surfaces at the *drain* of its step, so
+    the refill boards one iteration later than the old synchronous
+    same-iteration refill — but that drain (and the sink handoff, and
+    the prompt tokenization behind pull()) now runs WHILE the next
+    device step is in flight, so the refill's host cost vanishes from
+    the step cadence (measured: tok/s ratio unchanged, occupancy gauge
+    ~0.94 vs 0.96 — the release-to-readmit gap became visible, the
+    cadence did not stretch);
   * **double-buffered sink** — finished records land in a swap buffer on
     the scheduler thread (a list append, never I/O); a dedicated sink
     thread swaps it and does the host-side work (detokenize, JSON

@@ -28,6 +28,15 @@ dtype may differ — the RECEIVER converts on import (model-dtype pages
 quantize into an int8 pool, int8 pages dequantize into a model-dtype
 pool), so mixed fleets interoperate during a dtype migration.
 
+Overlapped-scheduler interplay (docs/performance.md "Overlapped
+scheduling"): the DECODE tier pipelines — migrations install while a
+step is in flight (the scatter import chains behind it on the device
+stream) and the first-token emit rides admission as before. The
+PREFILL tier never decodes, so `Engine.overlap` resolves off there;
+the page export in `_handoff_request` still runs behind an explicit
+`_flush("handoff")` guard pinning the settled-batch invariant the
+gather depends on.
+
 Failure semantics (the contract the unit tests pin):
 
   * a truncated/garbled frame kills only that connection — partially

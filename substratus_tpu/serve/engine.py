@@ -82,6 +82,19 @@ METRICS.describe(
     '{phase="decode"}).',
     type="gauge",
 )
+METRICS.histogram(
+    "substratus_serve_host_overlap_seconds",
+    "Host-side work (the deferred token read, emits, stop handling) "
+    "hidden under the in-flight decode step by the overlapped scheduler "
+    "(seconds; docs/performance.md \"Overlapped scheduling\").",
+)
+METRICS.describe(
+    "substratus_serve_pipeline_flushes_total",
+    "Overlapped-scheduler pipeline flushes by reason (spec|gang|handoff|"
+    "drain|preempt): points where the engine must observe a settled "
+    "batch before proceeding.",
+    type="counter",
+)
 # True counters (monotonic, rate()-able) for prefix-cache effectiveness —
 # the scrape-time substratus_serve_<stat> gauges mirror the same numbers
 # but only when a server is attached; these increment at admission.
@@ -172,6 +185,19 @@ class EngineConfig:
     # verify pass's position-0 sample (one token, plain-decode semantics).
     # 0 = off.
     spec_k: int = 0
+    # Overlapped decode scheduling (docs/performance.md "Overlapped
+    # scheduling"): dispatch decode step N+1 — with step N's sampled
+    # tokens fed back on-device — BEFORE reading step N's tokens to the
+    # host, so the per-token host work (the read, emits, detokenize
+    # downstream, EOS/window release, admission bookkeeping) runs while
+    # the device computes. Steady-state inter-token latency becomes
+    # max(device_step, host_work) instead of their sum. None = auto: on
+    # for single-host role=both/decode engines without speculation; off
+    # under lockstep sync (the leader must emit host tokens before
+    # encoding the gang's event broadcast — gangs run flush-per-step)
+    # and with spec_k (a speculative round needs a settled batch).
+    # False forces the synchronous scheduler — the escape hatch.
+    overlap: Optional[bool] = None
 
 
 @dataclass
@@ -212,6 +238,23 @@ class Request:
     submit_ts: float = 0.0
     last_emit_ts: float = 0.0
     trace_ctx: Optional[SpanContext] = None
+
+
+@dataclass
+class _InFlightStep:
+    """Bookkeeping for one dispatched decode step whose host read is
+    deferred (the overlapped scheduler's one-deep pipeline). `slots`
+    pins the (slot, Request) pairs active at dispatch: a slot released
+    before the drain (EOS/budget/cancel at the previous drain, or
+    preemption) fails the identity check and its in-flight token — the
+    pipeline's one wasted token per finished stream — is masked out
+    before emit. `pos_next` snapshots host_positions as of THIS step so
+    the context-window release check stays token-exact even after a
+    further dispatch has advanced the live array."""
+
+    tokens: Any  # device [B] int32 — this step's sampled tokens
+    slots: List[tuple]  # [(slot, Request)] active at dispatch
+    pos_next: np.ndarray  # host_positions after this step's increment
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -519,6 +562,37 @@ class Engine:
         self._sync_seq = 0
         self._sync_reqs: Dict[int, Request] = {}
         self._synced: List[Request] = []
+
+        # Overlapped decode scheduling (one-step-ahead dispatch; see
+        # EngineConfig.overlap). Resolution order matters: lockstep
+        # gangs and speculative engines run flush-per-step regardless of
+        # the config — the broadcast/verify walk must observe a settled
+        # batch — and a prefill-role engine never decodes at all.
+        overlap = ec.overlap if ec.overlap is not None else True
+        self.overlap = bool(
+            overlap
+            and ec.role != "prefill"
+            and self.sync is None
+            and not self.spec
+        )
+        self._pending: Optional[_InFlightStep] = None
+        # Device-resident copy of the last dispatched step's sampled
+        # tokens (the on-device feedback path) and the per-slot "the
+        # host value is newer" mask: admission writes a first token the
+        # device hasn't seen, so the next dispatch merges host values
+        # for fresh slots over device values for continuing ones.
+        self._dev_tokens = None
+        self._token_fresh = np.ones((B,), bool)
+        self._merge_tokens = jax.jit(
+            lambda dev, host, fresh: jnp.where(fresh, host, dev)
+        )
+        # Idle wake-up: submit()/resubmit()/submit_migration()/
+        # set_source()/stop() set this so an idle scheduler admits
+        # immediately instead of on the next poll tick; _idle_wait_s is
+        # the safety-net re-check period (tests stretch it to prove the
+        # event path carries first-token latency).
+        self._wake = threading.Event()
+        self._idle_wait_s = 0.05
 
         self._decode_fn = self._build_decode()
         self._sample1_fn = self._build_first_sample()
@@ -828,6 +902,7 @@ class Engine:
         if req.trace_ctx is None:
             req.trace_ctx = tracer.current_context()
         self.queue.put(req)
+        self._wake.set()
         if self.error is not None:
             # The scheduler may have died between the check above and the
             # put — its one-time queue drain could have run before the put,
@@ -849,6 +924,7 @@ class Engine:
             req.out.put(None)
             return
         self.queue.put(req)
+        self._wake.set()
         if self.error is not None:  # same submit() race: never strand it
             req.finish_reason = "error"
             req.out.put(None)
@@ -867,6 +943,7 @@ class Engine:
             mig.req.out.put(None)
             return
         self._migrations.put(mig)
+        self._wake.set()
         if self.error is not None:
             mig.req.finish_reason = "error"
             mig.req.out.put(None)
@@ -891,6 +968,7 @@ class Engine:
                 "receive pulled requests via the broadcast"
             )
         self.source = source
+        self._wake.set()
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -898,6 +976,7 @@ class Engine:
 
     def stop(self):
         self._stop.set()
+        self._wake.set()
         if self._thread:
             self._thread.join(timeout=30)
 
@@ -941,6 +1020,14 @@ class Engine:
         them identically."""
         if self.sync is None:
             return not self._stop.is_set()
+        # Gangs run flush-per-step: the event broadcast encodes
+        # decisions (admissions, cancel latches, stop) every process
+        # applies to a settled batch, and the leader's emits feed the
+        # consumers whose cancellations the broadcast latches — a
+        # pipelined step would tear both. Engine.overlap resolves off
+        # under sync; this drains any stray pipeline state and keeps
+        # today's lockstep semantics bit-for-bit.
+        self._flush("gang")
         from substratus_tpu.serve.multihost import (
             NullSink, decode_events, encode_events,
         )
@@ -1154,6 +1241,7 @@ class Engine:
         self._admit_counter += 1
         self.slot_admit_seq[slot] = self._admit_counter
         self.tokens[slot] = mig.first_token
+        self._token_fresh[slot] = True  # next dispatch feeds the host value
         self.positions[slot] = true_len
         self.temps[slot] = req.temperature
         self.top_ps[slot] = req.top_p
@@ -1168,7 +1256,11 @@ class Engine:
         """Prefill role: export the admitted slot's pages, free the slot,
         and hand (pages + first token + sampling state) to the transfer
         layer. The slot never activates — the decode tier owns the rest
-        of the request's lifecycle."""
+        of the request's lifecycle. The page export gathers from the
+        live pool, so it must observe a settled batch — a prefill-role
+        engine never decodes (overlap resolves off), making this flush a
+        no-op guard that pins the invariant."""
+        self._flush("handoff")
         pages = list(self.slot_pages.pages[slot])
         n = len(pages)
         cap = _bucket(n, 1)
@@ -1399,6 +1491,9 @@ class Engine:
         self._admit_counter += 1
         self.slot_admit_seq[slot] = self._admit_counter
         self.tokens[slot] = first_id
+        # The device token array predates this admission: the next
+        # dispatch must take this slot's first token from the host.
+        self._token_fresh[slot] = True
         self.positions[slot] = true_len
         self.temps[slot] = req.temperature
         self.top_ps[slot] = req.top_p
@@ -1464,6 +1559,18 @@ class Engine:
             pn = len(self.slot_pages.pages[slot])
             got = self._try_alloc(1)
             while got is None:
+                if self._pending is not None:
+                    # Preemption (and the truncation fallback below)
+                    # must observe a settled batch: the in-flight step's
+                    # drain may release slots and free pages on its own,
+                    # and a victim's resume prompt needs every token it
+                    # generated. Flush, then retry allocation before
+                    # evicting anyone.
+                    self._flush("preempt")
+                    if not self.active[slot]:
+                        return  # the flush released this very slot
+                    got = self._try_alloc(1)
+                    continue
                 victim = self._pick_victim(exclude=slot)
                 if victim is None:
                     req = self.slot_req[slot]
@@ -1479,22 +1586,40 @@ class Engine:
             self.slot_pages.append(slot, got[0])
             self.block_table[slot, pn] = got[0]
 
-    def _decode_step(self) -> None:
-        """One plain decode iteration: every active slot advances a token."""
-        t_step = time.perf_counter()
+    def _dispatch(self) -> Optional[_InFlightStep]:
+        """Device-only half of one decode step: grow paged capacity from
+        the host_positions mirror, feed the previous step's sampled
+        tokens back ON-DEVICE (merged with host-side first tokens for
+        slots admitted since the last dispatch), launch the jitted step,
+        and return the in-flight bookkeeping WITHOUT reading anything
+        back. Everything host-blocking belongs in _drain() — under the
+        overlapped scheduler it runs one full step later, while this
+        step occupies the device. Returns None when capacity handling
+        emptied the batch."""
         if self.paged:
             # Grow every slot that will cross a page boundary this step
-            # (may preempt or, at the limit, truncate).
+            # (may flush + preempt or, at the limit, truncate).
             for slot in np.flatnonzero(self.active):
                 self._ensure_capacity(int(slot))
             if not self.active.any():
-                return
+                return None
         lora, adapter_ids = self._lora_inputs()
+        if self._dev_tokens is None:
+            tok_in = self.tokens
+        else:
+            # Continuing slots chain the in-flight step's sampled token
+            # straight from its device output (JAX async dispatch makes
+            # this a device-side data dependency, never a host round
+            # trip); freshly (re)admitted slots take their first token
+            # from the host array admission wrote.
+            tok_in = self._merge_tokens(
+                self._dev_tokens, self.tokens, self._token_fresh
+            )
         next_tokens, self.cache, key_out = self._decode_fn(
             self.params,
             self.cache,
             self.block_table if self.paged else None,
-            self.tokens,
+            tok_in,
             self.positions,
             self.temps,
             self.top_ps,
@@ -1502,17 +1627,16 @@ class Engine:
             lora,
             adapter_ids,
         )
-        self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side so lockstep processes feed identical replicated inputs
-        # The simulated device-step floor lands BEFORE the host read and
-        # the emits: on a real accelerator tokens only exist once the
-        # device step finishes, so a slot freed by an emit is admissible
-        # in the very next iteration with no artificial dead time (the
-        # batchgen continuous-refill occupancy measures exactly this).
-        # _loop's own floor check then sees dt >= floor and never
-        # double-sleeps.
-        dt_step = time.perf_counter() - t_step
-        if self.ec.step_floor_s > dt_step:
-            time.sleep(self.ec.step_floor_s - dt_step)
+        if self.overlap:
+            # The RNG key stays device-resident between steps: reading
+            # it back here would block on the step just launched and
+            # re-serialize the pipeline. Single-host only — lockstep
+            # gangs (overlap off) need the host copy below.
+            self.key = key_out
+        else:
+            self.key = np.asarray(key_out)  # sublint: allow[hostsync]: overlap-off (lockstep/spec) fallback only — the key rides host-side so every gang process feeds identical replicated inputs; the overlapped path above keeps it on device
+        self._dev_tokens = next_tokens
+        self._token_fresh[:] = False
         # Clamp at the last cache row: active slots are released at the
         # window before reaching it (_emit's hit_window), so the clamp only
         # catches INACTIVE slots, whose positions otherwise drift past the
@@ -1522,10 +1646,105 @@ class Engine:
         last = self.ec.max_seq_len - 1
         self.positions = np.minimum(self.positions + 1, last)
         self.host_positions = np.minimum(self.host_positions + 1, last)
-        host_tokens = np.asarray(next_tokens)  # sublint: allow[hostsync]: THE one host read per decode step — emitting tokens requires it
-        self.tokens = host_tokens.copy()
-        for slot in np.flatnonzero(self.active):
-            self._emit(int(slot), int(host_tokens[slot]))
+        return _InFlightStep(
+            tokens=next_tokens,
+            slots=[
+                (int(s), self.slot_req[int(s)])
+                for s in np.flatnonzero(self.active)
+            ],
+            pos_next=self.host_positions.copy(),
+        )
+
+    def _drain(self, step: _InFlightStep) -> None:
+        """Host half of one decode step: THE deferred host read, then
+        per-slot emits, EOS/budget/window release, and cancellation
+        handling for the slots that were active at dispatch. A slot
+        whose request was released after that dispatch (EOS at the
+        previous drain, preemption, kill) fails the identity check and
+        its in-flight token — the pipeline's one wasted token per
+        finished stream — never reaches a consumer."""
+        host_tokens = np.asarray(step.tokens)  # sublint: allow[hostsync]: THE one host read per decode step — deferred to drain() so under overlap it lands after the NEXT dispatch, hiding every emit under device compute
+        for slot, req in step.slots:
+            if self.slot_req[slot] is not req:
+                continue  # EOS-lag mask: released or re-admitted slot
+            self.tokens[slot] = host_tokens[slot]
+            self._emit(
+                slot, int(host_tokens[slot]),
+                pos_next=int(step.pos_next[slot]),
+            )
+        if not self.overlap:
+            # Synchronous path (gangs, spec fallback): the next dispatch
+            # must feed pure host-side numpy — in lockstep every process
+            # replicates the identical input arrays, which is the whole
+            # broadcast contract. Device token feedback is overlap-only.
+            self._dev_tokens = None
+            self._token_fresh[:] = True
+
+    def _flush(self, reason: str) -> None:
+        """Drain the in-flight step NOW. Required before anything that
+        must observe a settled batch: a speculative round (reason
+        "spec"), the lockstep event broadcast ("gang"), a disaggregated
+        KV handoff ("handoff"), engine stop/drain ("drain"), and
+        preemption or pool-pressure truncation ("preempt")."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        METRICS.inc(
+            "substratus_serve_pipeline_flushes_total", {"reason": reason}
+        )
+        self._drain(pending)
+        # The batch is settled; the next dispatch feeds host tokens for
+        # every slot (on-device feedback resumes with the step after).
+        self._dev_tokens = None
+        self._token_fresh[:] = True
+
+    def _decode_step(self) -> None:
+        """One synchronous decode iteration: dispatch, model the device
+        step's latency, then drain immediately (the overlap-off path —
+        lockstep gangs and the speculative fallback). The simulated
+        device-step floor lands BEFORE the host read and the emits: on a
+        real accelerator tokens only exist once the device step
+        finishes, so a slot freed by an emit is admissible in the very
+        next iteration with no artificial dead time. _loop's own floor
+        check then sees dt >= floor and never double-sleeps."""
+        t_step = time.perf_counter()
+        pending = self._dispatch()
+        if pending is None:
+            return
+        dt_step = time.perf_counter() - t_step
+        if self.ec.step_floor_s > dt_step:
+            time.sleep(self.ec.step_floor_s - dt_step)
+        self._drain(pending)
+
+    def _step_overlapped(self) -> None:
+        """One pipelined iteration: launch step N, then run step N-1's
+        host work while N occupies the device. On a real chip the
+        deferred np.asarray overlaps the transfer with compute via JAX
+        async dispatch; on CPU the step_floor_s sleep models the device
+        window — the floor discounts whatever host work ran under it,
+        so steady-state inter-token latency settles at
+        max(device_step, host_work) instead of their sum."""
+        t_step = time.perf_counter()
+        # Dispatch FIRST, then pick up whatever is still pending: the
+        # dispatch's capacity handling may _flush("preempt") the
+        # previous step itself, and draining it again here would emit
+        # duplicate tokens.
+        launched = self._dispatch()
+        prev, self._pending = self._pending, launched
+        if prev is not None:
+            t_drain = time.perf_counter()
+            self._drain(prev)
+            if self._pending is not None:
+                # Host work actually hidden under an in-flight step —
+                # the overlapped scheduler's win, exported so operators
+                # can see how much host time the pipeline absorbs.
+                METRICS.observe(
+                    "substratus_serve_host_overlap_seconds",
+                    time.perf_counter() - t_drain,
+                )
+        dt_step = time.perf_counter() - t_step
+        if self.ec.step_floor_s > dt_step:
+            time.sleep(self.ec.step_floor_s - dt_step)
 
     @staticmethod
     def _prompt_lookup(ctx, k: int, max_n: int = 3):
@@ -1579,6 +1798,12 @@ class Engine:
         verify pass's position-0 sample. Cache staleness beyond the
         accepted point is safe: causal masking never reads past the query
         position, and the next round rewrites exactly those slots."""
+        # A speculative round proposes from slot_tokens and walks the
+        # verify output against settled per-slot state — it must never
+        # start with a step in flight. Spec engines resolve overlap off,
+        # so this is a no-op guard that keeps the invariant explicit
+        # (and keeps a future dynamic spec<->plain switchover honest).
+        self._flush("spec")
         t_step = time.perf_counter()
         k = self.ec.spec_k
         # Speculation only pays off for greedy slots; an all-sampling batch
@@ -1704,13 +1929,22 @@ class Engine:
         self.cache = self._restore_slot(self.cache, slot_cache, slot)
         return last_logits
 
-    def _emit(self, slot: int, token_id: int):
+    def _emit(self, slot: int, token_id: int,
+              pos_next: Optional[int] = None):
+        """Deliver one token. `pos_next` is the slot's next-write
+        position AS OF THE STEP THAT SAMPLED the token: _drain passes
+        its dispatch-time snapshot because under overlap the live
+        host_positions already advanced for the next in-flight step —
+        reading it here would release window-bounded requests one token
+        early and break token-exactness vs the synchronous scheduler."""
         req = self.slot_req[slot]
         eos = req.eos_token_id if req.eos_token_id is not None else self.ec.eos_token_id
         self.slot_generated[slot] += 1
+        if pos_next is None:
+            pos_next = int(self.host_positions[slot])
         hit_eos = token_id == eos
         hit_budget = self.slot_generated[slot] >= req.max_tokens
-        hit_window = int(self.host_positions[slot]) + 1 >= self.ec.max_seq_len
+        hit_window = pos_next + 1 >= self.ec.max_seq_len
         cancelled = self._is_cancelled(req)
         if not hit_eos and not cancelled:
             now = time.perf_counter()
@@ -1737,14 +1971,24 @@ class Engine:
                 self._sync_reqs.pop(req.sync_id, None)
             self._release_slot(slot)
 
+    def _step(self) -> None:
+        """One scheduler step on the resolved path: pipelined when
+        overlap is on, speculative or plain-synchronous otherwise."""
+        if self.overlap:
+            self._step_overlapped()
+        elif self.spec:
+            self._spec_step()
+        else:
+            self._decode_step()
+
     def _loop(self):
         try:
             while self._sync_iterate():
                 t_admit = time.perf_counter()
                 if self._admit():
                     # Only iterations that boarded someone observe the
-                    # admission phase — an idle engine polling its empty
-                    # queue at 500 Hz would otherwise flood the histogram
+                    # admission phase — an idle engine waking on its
+                    # empty queue would otherwise flood the histogram
                     # with ~0 s samples.
                     METRICS.observe(
                         "substratus_serve_phase_seconds",
@@ -1752,9 +1996,21 @@ class Engine:
                         {"phase": "admission"},
                     )
                 if not self.active.any():
-                    # Lockstep mode pays a collective per iteration, so
-                    # idle gangs tick slower (<=20ms first-token cost).
-                    time.sleep(0.02 if self.sync is not None else 0.002)
+                    # Nothing decoding implies nothing in flight either
+                    # (pipelined slots stay active until drained). Block
+                    # on the wake event instead of poll-spinning:
+                    # submit()/resubmit()/submit_migration()/
+                    # set_source()/stop() set it, so first-token
+                    # admission latency is event-driven, not a poll-tick
+                    # coin flip. Lockstep gangs keep the 20ms tick —
+                    # every iteration pays a collective, and a
+                    # follower's wake event never fires for leader-side
+                    # submissions.
+                    if self.sync is not None:
+                        time.sleep(0.02)
+                    else:
+                        self._wake.wait(timeout=self._idle_wait_s)
+                        self._wake.clear()
                     continue
                 METRICS.observe(
                     "substratus_serve_batch_occupancy_ratio",
@@ -1771,19 +2027,13 @@ class Engine:
                     # executable compile; record it separately so the
                     # steady-state decode histogram stays unpolluted.
                     with tracer.span("engine.first_compile") as span:
-                        if self.spec:
-                            self._spec_step()
-                        else:
-                            self._decode_step()
+                        self._step()
                         dt = time.perf_counter() - t_decode
                         span.set_attribute("seconds", round(dt, 6))
                     self._first_decode_done = True
                     METRICS.set("substratus_serve_first_compile_seconds", dt)
                     continue
-                if self.spec:
-                    self._spec_step()
-                else:
-                    self._decode_step()
+                self._step()
                 dt_decode = time.perf_counter() - t_decode
                 METRICS.observe(
                     "substratus_serve_phase_seconds",
@@ -1793,6 +2043,11 @@ class Engine:
                 if self.ec.step_floor_s > dt_decode:
                     # Simulated device-step latency (see EngineConfig).
                     time.sleep(self.ec.step_floor_s - dt_decode)
+            # Clean stop with a step still in flight (stop() during
+            # decode, a gang stop event, server drain): deliver its
+            # tokens before the thread exits — consumers of in-flight
+            # streams must see every sampled token, then their None.
+            self._flush("drain")
         except BaseException as e:  # propagate to waiting callers
             self.error = e
             if self.sync is not None and self.sync.leader:
@@ -1867,6 +2122,10 @@ class Engine:
             # is — the gateway's role-aware routing reads both.
             "role": self.ec.role,
             "transfer_queue_depth": transfer_q,
+            # Overlapped decode scheduling (resolved value, not the
+            # config): whether this engine pipelines host work under the
+            # in-flight device step (docs/performance.md).
+            "overlap": self.overlap,
             # Prefix-cache effectiveness, mirrored for /loadz consumers
             # (also on /metrics as the *_total counters).
             "prefill_tokens": self.stats["prefill_tokens"],

@@ -328,6 +328,11 @@ def main(argv=None) -> int:
         kv_cache_dtype=params_json.get("kv_cache_dtype", "model"),
         kv_layout=kv_layout,
         max_queue=max_queue_raw if max_queue_raw > 0 else None,
+        # Overlapped decode scheduling escape hatch (params.json
+        # {"overlap": false} forces the synchronous scheduler; absent =
+        # auto — on for single-host role=both/decode, off under
+        # lockstep sync and speculation; docs/performance.md).
+        overlap=params_json.get("overlap"),
     )
     # Multi-chip serving: tensor-parallel over as many chips as the kv heads
     # allow (params.json {"tensor": N} overrides), data-parallel the rest.

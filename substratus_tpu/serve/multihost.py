@@ -31,6 +31,18 @@ case — the message rides a fixed buffer with its length in the first
 four bytes — and a second, bucket-padded collective only when a burst of
 long prompts overflows it. Fixed buffer sizes mean each shape compiles
 once.
+
+Lockstep gangs and the overlapped scheduler: `Engine.overlap` (one-
+step-ahead dispatch, docs/performance.md "Overlapped scheduling")
+resolves OFF whenever a sync is attached. The event broadcast encodes
+decisions every process applies to a settled batch, the leader must
+host-read step N's tokens before its consumers can cancel into step
+N+1's event frame, and the engine feeds pure host-numpy inputs so all
+processes replicate them identically — a pipelined step would tear all
+three. Gangs therefore run flush-per-step (`Engine._flush("gang")` at
+the top of `_sync_iterate`), preserving the exact pre-overlap
+semantics; the ~20 ms idle tick also stays (a follower's wake event
+cannot fire for leader-side submissions).
 """
 from __future__ import annotations
 

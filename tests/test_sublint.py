@@ -263,6 +263,78 @@ def test_hostsync_missing_root_is_a_finding(tmp_path):
     assert any("not found" in f.message for f in active(findings, "hostsync"))
 
 
+DISPATCH_SPLIT = """
+import numpy as np
+
+class Engine:
+    def _dispatch(self):
+        self.key = np.asarray(self.key_out)
+        return object()
+
+    def _drain(self, step):
+        toks = np.asarray(step.tokens)
+        return toks
+
+    def _loop(self):
+        while True:
+            pending = self._dispatch()
+            self._drain(pending)
+"""
+
+
+def _split_check():
+    return HostSyncCheck(
+        roots=(("serve/engine.py", "Engine._loop"),),
+        stall_roots=(("serve/engine.py", "Engine._dispatch"),),
+    )
+
+
+def test_hostsync_dispatch_sync_is_a_pipeline_stall(tmp_path):
+    """Deferred-read idiom: a sync reachable from the dispatch half
+    reports as a PIPELINE STALL and wins the per-site dedupe over the
+    plain loop-reachable report; the drain's deferred read stays a
+    plain hot-loop finding."""
+    findings = lint_snippet(
+        tmp_path, DISPATCH_SPLIT, [_split_check()], rel="serve/engine.py"
+    )
+    msgs = [f.message for f in active(findings, "hostsync")]
+    assert len(msgs) == 2, msgs  # the dispatch site reports exactly once
+    stalls = [m for m in msgs if "PIPELINE STALL" in m]
+    assert len(stalls) == 1 and "Engine._dispatch" in stalls[0], msgs
+    plain = [m for m in msgs if "PIPELINE STALL" not in m]
+    assert len(plain) == 1 and "Engine._drain" in plain[0], msgs
+
+
+def test_hostsync_missing_stall_root_is_a_finding(tmp_path):
+    """Renaming the dispatch half away silently would drop the stall
+    protection — the family complains instead."""
+    findings = lint_snippet(
+        tmp_path,
+        "class Engine:\n    def _loop(self):\n        pass\n",
+        [_split_check()],
+        rel="serve/engine.py",
+    )
+    assert any(
+        "STALL_ROOTS" in f.message for f in active(findings, "hostsync")
+    )
+
+
+def test_shipped_dispatch_half_is_sync_free():
+    """The live engine honors the idiom: zero unsuppressed hostsync
+    findings repo-wide, and the only suppressed sync lexically inside
+    _dispatch is the overlap-off RNG-key fallback (the deferred token
+    read lives in _drain)."""
+    files = load_files(REPO_ROOT, discover(REPO_ROOT))
+    findings = run_checks(files, [HostSyncCheck()])
+    assert active(findings, "hostsync") == []
+    stalls = [
+        f for f in findings
+        if f.suppressed and "PIPELINE STALL" in f.message
+    ]
+    assert len(stalls) == 1, [f.message for f in stalls]
+    assert "gang process" in (stalls[0].reason or ""), stalls[0].reason
+
+
 # --- concurrency ----------------------------------------------------------
 
 

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke gateway-bench adapter-bench disagg-bench overlap-bench prefix-bench batchgen-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke gateway-bench adapter-bench disagg-bench overlap-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -92,6 +92,14 @@ bench-smoke:
 gateway-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/gateway_smoke.py
 
+# Fleet telemetry smoke (ISSUE 11 acceptance): 2 in-process replicas
+# behind the gateway — /debug/fleetz must show BOTH replicas with
+# non-empty ring-buffer series + EWMA signals, a consistent fleet
+# rollup, merged SLO percentiles from the /loadz poll path, and the
+# substratus_fleet_* families on /metrics (tools/fleet_smoke.py).
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
+
 # Routed-2-replica vs direct throughput/TTFT capture (ISSUE 5
 # acceptance: routed aggregate tok/s >= 1.7x single replica on the
 # smoke shape). Spawns replica server subprocesses; heavier than
@@ -125,7 +133,11 @@ disagg-bench:
 # detokenize host work in the emit path — steady-state inter-token
 # mean must hold <= 1.15x the device floor with aggregate tok/s within
 # 5% or better, greedy outputs token-exact (tests/test_overlap.py
-# asserts; docs/performance.md "Overlapped scheduling").
+# asserts; docs/performance.md "Overlapped scheduling"). The capture
+# also embeds hard gates bench_compare --validate evaluates (ISSUE 11):
+# bubble ratio <= 0.15, bubble attribution coverage >= 0.9, tok/s vs
+# sync >= 0.95 — a host-path regression fails here WITH a cause
+# (docs/performance.md "Pipeline-bubble attribution").
 overlap-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --overlap \
 	  | $(PY) hack/bench_compare.py --validate -

@@ -76,6 +76,50 @@ def validate_record(record, where: str = "capture") -> list:
         problems.append(f"{where}: value {value!r} is not a number or null")
     elif not math.isfinite(value) or value <= 0:
         problems.append(f"{where}: value {value!r} is not finite positive")
+    problems += check_gates(record, where)
+    return problems
+
+
+def check_gates(record: dict, where: str = "capture") -> list:
+    """Evaluate a capture's embedded hard gates (empty = all pass).
+
+    A record may carry `"gates": [{"name", "value", "min"?|"max"?}]` —
+    in-capture acceptance thresholds the producing bench computed
+    (e.g. the overlap leg's bubble ratio). Unlike the history-relative
+    regression gate, these are ABSOLUTE: `--validate` fails on any
+    breach, so `make overlap-bench` catches a host-path regression
+    even on a fresh checkout with no BENCH_* trajectory."""
+    gates = record.get("gates")
+    if gates is None:
+        return []
+    problems = []
+    if not isinstance(gates, list):
+        return [f"{where}: 'gates' is not a list"]
+    for i, g in enumerate(gates):
+        tag = f"{where}: gate[{i}]"
+        if not isinstance(g, dict) or not isinstance(g.get("name"), str):
+            problems.append(f"{tag}: malformed (need name + value)")
+            continue
+        name = g["name"]
+        v = g.get("value")
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or not math.isfinite(v):
+            problems.append(
+                f"{tag} {name}: value {v!r} is not a finite number"
+            )
+            continue
+        if "min" not in g and "max" not in g:
+            problems.append(f"{tag} {name}: carries neither min nor max")
+        if "min" in g and v < g["min"]:
+            problems.append(
+                f"{where}: gate {name} = {v:g} below its floor "
+                f"{g['min']:g}"
+            )
+        if "max" in g and v > g["max"]:
+            problems.append(
+                f"{where}: gate {name} = {v:g} above its ceiling "
+                f"{g['max']:g}"
+            )
     return problems
 
 
@@ -199,6 +243,24 @@ def self_test() -> list:
         failures.append("contractual null capture failed validation")
     if not compare(null_cap, hist, DEFAULT_THRESHOLD):
         failures.append("null new capture passed the gate")
+    # Embedded hard gates must gate (the bubble-ratio contract of
+    # make overlap-bench rides on this).
+    gated = {"metric": "m", "unit": "t/s", "value": 1.0}
+    ok_gates = [
+        {"name": "bubble_ratio", "value": 0.05, "max": 0.15},
+        {"name": "attributed_frac", "value": 0.98, "min": 0.9},
+    ]
+    if validate_record({**gated, "gates": ok_gates}):
+        failures.append("passing gates flagged")
+    for bad_gate in (
+        {"name": "bubble_ratio", "value": 0.3, "max": 0.15},  # breach
+        {"name": "attributed_frac", "value": 0.5, "min": 0.9},  # breach
+        {"name": "nan_gate", "value": float("nan"), "max": 1.0},
+        {"name": "no_bound", "value": 1.0},
+        {"value": 1.0, "max": 2.0},  # nameless
+    ):
+        if not validate_record({**gated, "gates": [bad_gate]}):
+            failures.append(f"gate breach not flagged: {bad_gate}")
     for bad in (
         {"unit": "t/s", "value": 1},
         {"metric": "m", "unit": "t/s", "value": float("nan")},

@@ -51,6 +51,32 @@ def main() -> int:
         "substratus_gateway_ejections_total", {"replica": "http://r0:8080"}
     )
     METRICS.observe("substratus_gateway_upstream_seconds", 0.05)
+    # Fleet telemetry plane (gateway/fleet.py + observability/timeline.py
+    # + observability/sketch.py): drive the aggregator and an SLO
+    # tracker for real so the per-replica gauges, drop counters, bubble
+    # counter, and burn counter all render through the same exposition.
+    from substratus_tpu.gateway.fleet import FleetAggregator
+    from substratus_tpu.gateway.loadreport import LoadReport
+    from substratus_tpu.observability.sketch import SLOTracker
+    from substratus_tpu.observability.timeline import StepTimeline
+
+    fleet = FleetAggregator()
+    fleet.record(
+        "http://r0:8080",
+        LoadReport(queue_depth=2, active_slots=3, max_slots=4, seq=1,
+                   wall_ts=__import__("time").time()),
+    )
+    fleet.record(  # out-of-order: exercises the dropped counter
+        "http://r0:8080", LoadReport(seq=1), now=1.0,
+    )
+    fleet.record_shed("http://r0:8080")
+    fleet.signals()
+    slo = SLOTracker()
+    slo.observe("ttft", 5.0)  # over budget: burns
+    StepTimeline().record_iteration(
+        t_start=0.0, wall_s=0.02, admit_s=0.004, admitted=1,
+        dispatch_s=0.001, drain_s=0.01, configured_floor_s=0.015,
+    )
     client = sci.FakeSCIClient()
     client.get_object_md5("gs://bucket", "obj")
     client.create_signed_url("gs://bucket", "obj", "d41d8cd9")

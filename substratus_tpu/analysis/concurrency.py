@@ -48,6 +48,13 @@ DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     # sampler thread reads progress — every shared write rides
     # self._lock (docs/batch-generation.md).
     "serve/batchgen.py",
+    # Fleet telemetry (ISSUE 11): the aggregator is event-loop
+    # confined like the balancer, and the step-timeline ring is
+    # written by the engine scheduler thread while /debug/stepz
+    # handlers read it — both keep the same scrutiny so an unlocked
+    # shared write added later gets flagged, not shipped.
+    "gateway/fleet.py",
+    "observability/timeline.py",
 )
 
 _BLOCKING = {

@@ -1,0 +1,443 @@
+"""Fleet telemetry aggregator: per-replica load time series + sustained
+signals + the autoscaler's input contract.
+
+Every ``x-substratus-load`` report and ``/loadz`` poll today informs one
+routing decision and evaporates. This module retains them: each replica
+gets a bounded ring-buffer time series and EWMA-smoothed sustained
+signals (queue depth, slot occupancy, free KV fraction, transfer-queue
+depth, shed rate), rolled up fleet-wide and published three ways:
+
+  * ``GET /debug/fleetz`` (gateway/router.py, RBAC-gated like the
+    server's /debug plane) — per-replica series + EWMAs + merged SLO
+    percentiles, the human/debug view;
+  * ``substratus_fleet_*`` gauges on the gateway's ``/metrics``;
+  * ``FleetAggregator.signals()`` -> ``FleetSignals`` — the TYPED
+    contract the controller autoscaler consumes (ROADMAP item 1):
+    sustained signals only, no instantaneous noise, no HTTP parsing.
+
+Ordering: reports carry a per-replica monotonic sequence number and a
+replica wall-clock timestamp (``sq=``/``ts=`` on the header —
+gateway/loadreport.py). A hedged or retried response can deliver an
+OLD report after a newer one already arrived; seq catches that
+exactly, and the wall clock rejects grossly stale retransmits (the
+tolerance is generous — cross-host clock skew must not eat live
+reports). Legacy reports (no ``sq=``) are always accepted.
+
+Single-writer contract: the router calls everything from one asyncio
+event loop (same as balancer.py) — no locks here, and adding threads
+would need them (sublint's concurrency family watches this module).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from substratus_tpu.gateway.loadreport import LoadReport
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.sketch import Sketch
+
+# Fleet metric catalog (docs/observability.md "Fleet telemetry").
+# Per-replica gauges are written at record time (event-loop cheap) and
+# REMOVED on eviction so a dead replica stops being scraped as live.
+for _name, _help in (
+    ("substratus_fleet_queue_depth",
+     "EWMA-smoothed waiting-queue depth per replica."),
+    ("substratus_fleet_occupancy",
+     "EWMA-smoothed decode-slot occupancy (active/max) per replica."),
+    ("substratus_fleet_kv_free_frac",
+     "EWMA-smoothed free KV-pool fraction per replica."),
+    ("substratus_fleet_transfer_queue",
+     "EWMA-smoothed KV transfer-queue depth (tq=) per replica."),
+    ("substratus_fleet_shed_rate",
+     "Replica-originated sheds (429/503) per second, windowed."),
+    ("substratus_fleet_slo_burn",
+     "Latest reported SLO-burn count per replica and slo."),
+):
+    METRICS.describe(_name, _help, type="gauge")
+METRICS.describe(
+    "substratus_fleet_replicas",
+    "Replicas with live telemetry series, by role.", type="gauge",
+)
+METRICS.describe(
+    "substratus_fleet_reports_total",
+    "Load reports accepted into the fleet time series, by replica.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_fleet_reports_dropped_total",
+    "Load reports rejected (reason: out_of_order|stale).",
+    type="counter",
+)
+
+_EWMA_FIELDS = (
+    "queue_depth", "occupancy", "kv_free_frac", "transfer_queue",
+)
+_GAUGE_OF = {
+    "queue_depth": "substratus_fleet_queue_depth",
+    "occupancy": "substratus_fleet_occupancy",
+    "kv_free_frac": "substratus_fleet_kv_free_frac",
+    "transfer_queue": "substratus_fleet_transfer_queue",
+}
+
+
+@dataclass(frozen=True)
+class ReplicaSignals:
+    """One replica's sustained load: EWMA-smoothed, staleness-annotated.
+    The per-replica row of the autoscaler contract."""
+
+    url: str
+    role: str
+    samples: int
+    age_s: float  # since the last accepted report
+    seq: int  # last accepted sequence number (-1 = legacy reports)
+    queue_depth: float
+    occupancy: float
+    kv_free_frac: float
+    transfer_queue: float
+    shed_rate: float  # replica-originated sheds per second
+
+
+@dataclass(frozen=True)
+class FleetSignals:
+    """The autoscaler's input (ROADMAP item 1): sustained fleet-wide
+    rollups plus the per-replica rows they were rolled up from.
+
+    Semantics a reconcile loop can act on directly: ``queue_depth`` and
+    ``transfer_queue`` SUM across replicas (total backlog — scale-up
+    pressure; transfer queue is the prefill:decode rebalance signal),
+    ``occupancy`` is the MEAN (sustained utilization — scale-down
+    evidence), ``kv_free_frac`` is the MIN (the tightest replica
+    preempts first), ``shed_rate`` SUMS (user-visible overload)."""
+
+    ts: float  # aggregator clock (monotonic) at snapshot
+    replicas: Tuple[ReplicaSignals, ...]
+    queue_depth: float
+    occupancy: float
+    kv_free_frac: float
+    transfer_queue: float
+    shed_rate: float
+    roles: Mapping[str, int]
+
+
+class _ReplicaSeries:
+    """Ring-buffer time series + EWMA state for one replica."""
+
+    __slots__ = (
+        "url", "role", "last_seq", "last_wall_ts", "last_mono",
+        "reports", "ring", "ewma", "sheds", "shed_times", "slo",
+    )
+
+    def __init__(self, url: str, capacity: int):
+        self.url = url
+        self.role = "both"
+        self.last_seq = -1
+        self.last_wall_ts = 0.0
+        self.last_mono: Optional[float] = None
+        self.reports = 0
+        # (t_mono, queue_depth, occupancy, kv_free_frac, transfer_queue)
+        self.ring: deque = deque(maxlen=capacity)
+        self.ewma: Dict[str, float] = {}
+        self.sheds = 0
+        self.shed_times: deque = deque(maxlen=256)
+        # {slo: {"threshold_s", "burn", "sketch": Sketch}} — latest
+        # replica-cumulative state from /loadz (header reports are too
+        # small to carry sketches).
+        self.slo: Dict[str, dict] = {}
+
+
+class FleetAggregator:
+    """Per-replica ring-buffer series + EWMA signals + fleet rollups."""
+
+    def __init__(
+        self,
+        capacity: int = 240,
+        halflife_s: float = 10.0,
+        stale_s: float = 30.0,
+        evict_s: float = 120.0,
+        shed_window_s: float = 30.0,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity {capacity} invalid")
+        self.capacity = capacity
+        self.halflife_s = max(1e-3, halflife_s)
+        self.stale_s = stale_s
+        self.evict_s = evict_s
+        self.shed_window_s = max(1e-3, shed_window_s)
+        self._series: Dict[str, _ReplicaSeries] = {}
+        self._gauged_roles: set = set()
+
+    # -- ingestion ---------------------------------------------------------
+
+    def record(self, url: str, report: LoadReport,
+               now: Optional[float] = None,
+               snapshot: Optional[Mapping] = None) -> bool:
+        """Ingest one load report. Returns False when the report was
+        dropped (stale or out-of-order — the caller should not feed it
+        to the balancer either). ``snapshot`` is the full /loadz body
+        when the report came from a poll; it carries the SLO sketches."""
+        now = time.monotonic() if now is None else now
+        url = url.rstrip("/")
+        sr = self._series.get(url)
+        if sr is None:
+            sr = self._series[url] = _ReplicaSeries(url, self.capacity)
+        if report.seq >= 0 and sr.last_seq >= 0 \
+                and report.seq <= sr.last_seq:
+            # Sequence went backwards. A RESTARTED replica resets its
+            # counter too — but its wall clock keeps moving, so a
+            # fresh-process report carries ts strictly newer than the
+            # last accepted one; only deliveries that are old on BOTH
+            # axes are stale echoes of hedged/retried responses.
+            restarted = (
+                report.wall_ts > 0.0
+                and report.wall_ts > sr.last_wall_ts
+            )
+            if not restarted:
+                METRICS.inc(
+                    "substratus_fleet_reports_dropped_total",
+                    {"reason": "out_of_order"},
+                )
+                return False
+            sr.last_seq = -1  # new counter epoch
+        if report.wall_ts > 0.0 \
+                and time.time() - report.wall_ts > self.stale_s:
+            METRICS.inc(
+                "substratus_fleet_reports_dropped_total",
+                {"reason": "stale"},
+            )
+            return False
+
+        occupancy = report.active_slots / max(1, report.max_slots)
+        values = {
+            "queue_depth": float(report.queue_depth),
+            "occupancy": occupancy,
+            "kv_free_frac": float(report.kv_free_frac),
+            "transfer_queue": float(report.transfer_queue),
+        }
+        if sr.last_mono is None or not sr.ewma:
+            for k, v in values.items():
+                sr.ewma[k] = v
+        else:
+            # Time-aware EWMA: the smoothing weight decays with the gap
+            # since the previous report, so a replica reporting at 100
+            # rps and one polled every 2 s smooth over the SAME wall
+            # time, not the same sample count.
+            dt = max(0.0, now - sr.last_mono)
+            w = 0.5 ** (dt / self.halflife_s)
+            for k, v in values.items():
+                sr.ewma[k] = w * sr.ewma[k] + (1.0 - w) * v
+        sr.ring.append((
+            round(now, 3), report.queue_depth, round(occupancy, 4),
+            round(report.kv_free_frac, 4), report.transfer_queue,
+        ))
+        sr.role = report.role
+        if report.seq >= 0:
+            sr.last_seq = report.seq
+        if report.wall_ts > 0.0:
+            sr.last_wall_ts = report.wall_ts
+        sr.last_mono = now
+        sr.reports += 1
+        if snapshot is not None:
+            self._record_slo(sr, snapshot.get("slo"))
+        METRICS.inc("substratus_fleet_reports_total", {"replica": url})
+        for k, v in sr.ewma.items():
+            METRICS.set(_GAUGE_OF[k], round(v, 4), {"replica": url})
+        self._evict_dead(now)
+        return True
+
+    def _record_slo(self, sr: _ReplicaSeries, slo: object) -> None:
+        if not isinstance(slo, Mapping):
+            return
+        for name, entry in slo.items():
+            if not isinstance(entry, Mapping):
+                continue
+            try:
+                sketch = Sketch.from_dict(entry.get("sketch") or {})
+            except ValueError:
+                continue  # garbled sketch must not poison the merge
+            sr.slo[str(name)] = {
+                "threshold_s": float(entry.get("threshold_s", 0.0)),
+                "burn": int(entry.get("burn", 0)),
+                "sketch": sketch,
+            }
+            METRICS.set(
+                "substratus_fleet_slo_burn",
+                sr.slo[str(name)]["burn"],
+                {"replica": sr.url, "slo": str(name)},
+            )
+
+    def record_shed(self, url: str, now: Optional[float] = None) -> None:
+        """A replica answered 429/503 (shedding by contract): the
+        sustained shed rate is overload evidence no queue-depth EWMA
+        carries once the queue bound is doing its job."""
+        now = time.monotonic() if now is None else now
+        url = url.rstrip("/")
+        sr = self._series.get(url)
+        if sr is None:
+            sr = self._series[url] = _ReplicaSeries(url, self.capacity)
+        sr.sheds += 1
+        sr.shed_times.append(now)
+        METRICS.set(
+            "substratus_fleet_shed_rate",
+            round(self._shed_rate(sr, now), 4), {"replica": url},
+        )
+
+    def _shed_rate(self, sr: _ReplicaSeries, now: float) -> float:
+        cutoff = now - self.shed_window_s
+        recent = sum(1 for t in sr.shed_times if t > cutoff)
+        return recent / self.shed_window_s
+
+    def _evict_dead(self, now: float) -> None:
+        """Forget replicas with no accepted report for evict_s: a
+        scaled-down or crashed replica must drop out of the rollups
+        (and /metrics) instead of pinning its last-known load forever."""
+        for url in [
+            u for u, sr in self._series.items()
+            if sr.last_mono is not None
+            and now - sr.last_mono > self.evict_s
+        ]:
+            sr = self._series.pop(url)
+            for gauge in _GAUGE_OF.values():
+                METRICS.remove(gauge, {"replica": url})
+            METRICS.remove("substratus_fleet_shed_rate", {"replica": url})
+            for name in sr.slo:
+                METRICS.remove(
+                    "substratus_fleet_slo_burn",
+                    {"replica": url, "slo": name},
+                )
+
+    # -- consumption -------------------------------------------------------
+
+    def replica_signals(self, sr: _ReplicaSeries,
+                        now: float) -> ReplicaSignals:
+        return ReplicaSignals(
+            url=sr.url,
+            role=sr.role,
+            samples=sr.reports,
+            age_s=round(now - sr.last_mono, 3)
+            if sr.last_mono is not None else float("inf"),
+            seq=sr.last_seq,
+            queue_depth=round(sr.ewma.get("queue_depth", 0.0), 4),
+            occupancy=round(sr.ewma.get("occupancy", 0.0), 4),
+            kv_free_frac=round(sr.ewma.get("kv_free_frac", 1.0), 4),
+            transfer_queue=round(sr.ewma.get("transfer_queue", 0.0), 4),
+            shed_rate=round(self._shed_rate(sr, now), 4),
+        )
+
+    def signals(self, now: Optional[float] = None) -> FleetSignals:
+        """The autoscaler contract: sustained per-replica signals +
+        fleet rollups. Pure data — consumers never touch HTTP, headers,
+        or the aggregator's internals."""
+        now = time.monotonic() if now is None else now
+        self._evict_dead(now)
+        reps = tuple(
+            self.replica_signals(sr, now)
+            for sr in sorted(self._series.values(), key=lambda s: s.url)
+        )
+        roles: Dict[str, int] = {}
+        for r in reps:
+            roles[r.role] = roles.get(r.role, 0) + 1
+        for role, n in roles.items():
+            METRICS.set("substratus_fleet_replicas", n, {"role": role})
+        for role in self._gauged_roles - set(roles):
+            METRICS.remove("substratus_fleet_replicas", {"role": role})
+        self._gauged_roles = set(roles)
+        return FleetSignals(
+            ts=now,
+            replicas=reps,
+            queue_depth=round(sum(r.queue_depth for r in reps), 4),
+            occupancy=round(
+                sum(r.occupancy for r in reps) / len(reps), 4
+            ) if reps else 0.0,
+            kv_free_frac=round(
+                min((r.kv_free_frac for r in reps), default=1.0), 4
+            ),
+            transfer_queue=round(sum(r.transfer_queue for r in reps), 4),
+            shed_rate=round(sum(r.shed_rate for r in reps), 4),
+            roles=roles,
+        )
+
+    def merged_slo(self) -> Dict[str, dict]:
+        """Fleet-wide SLO view: per-SLO merged sketch percentiles +
+        summed burn across replicas (exact — fixed-bucket sketches
+        merge by adding counts, observability/sketch.py)."""
+        out: Dict[str, dict] = {}
+        for sr in self._series.values():
+            for name, entry in sr.slo.items():
+                agg = out.get(name)
+                if agg is None:
+                    agg = out[name] = {
+                        "threshold_s": entry["threshold_s"],
+                        "burn": 0,
+                        "sketch": Sketch(entry["sketch"].bounds),
+                    }
+                try:
+                    agg["sketch"].merge(entry["sketch"])
+                except ValueError:
+                    continue  # mismatched bounds: skip, never corrupt
+                agg["burn"] += entry["burn"]
+        rendered: Dict[str, dict] = {}
+        for name, agg in out.items():
+            sk: Sketch = agg["sketch"]
+            rendered[name] = {
+                "threshold_s": agg["threshold_s"],
+                "burn": agg["burn"],
+                "count": sk.count,
+                "p50_s": sk.quantile(0.5),
+                "p90_s": sk.quantile(0.9),
+                "p99_s": sk.quantile(0.99),
+            }
+        return rendered
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """The /debug/fleetz payload: per-replica series + EWMAs + SLO
+        percentiles, and the fleet rollup (FleetSignals, rendered)."""
+        now = time.monotonic() if now is None else now
+        sig = self.signals(now)
+        replicas = {}
+        for sr in self._series.values():
+            rs = self.replica_signals(sr, now)
+            rep_slo = {}
+            for name, entry in sr.slo.items():
+                sk: Sketch = entry["sketch"]
+                rep_slo[name] = {
+                    "threshold_s": entry["threshold_s"],
+                    "burn": entry["burn"],
+                    "count": sk.count,
+                    "p50_s": sk.quantile(0.5),
+                    "p99_s": sk.quantile(0.99),
+                }
+            replicas[sr.url] = {
+                "role": sr.role,
+                "seq": sr.last_seq,
+                "age_s": rs.age_s,
+                "reports": sr.reports,
+                "sheds": sr.sheds,
+                "ewma": {
+                    "queue_depth": rs.queue_depth,
+                    "occupancy": rs.occupancy,
+                    "kv_free_frac": rs.kv_free_frac,
+                    "transfer_queue": rs.transfer_queue,
+                    "shed_rate": rs.shed_rate,
+                },
+                # The ring, oldest first: [t_mono, queue_depth,
+                # occupancy, kv_free_frac, transfer_queue] rows.
+                "series": [list(row) for row in sr.ring],
+                "slo": rep_slo,
+            }
+        return {
+            "now_mono": round(now, 3),
+            "halflife_s": self.halflife_s,
+            "replicas": replicas,
+            "fleet": {
+                "replicas": len(sig.replicas),
+                "roles": dict(sig.roles),
+                "queue_depth": sig.queue_depth,
+                "occupancy": sig.occupancy,
+                "kv_free_frac": sig.kv_free_frac,
+                "transfer_queue": sig.transfer_queue,
+                "shed_rate": sig.shed_rate,
+                "slo": self.merged_slo(),
+            },
+        }

@@ -55,6 +55,15 @@ class LoadReport:
     # client completions directly (balancer.pick(role=...)).
     role: str = "both"
     transfer_queue: int = 0
+    # Ordering (gateway/fleet.py): a per-replica monotonic report
+    # sequence number (`sq=`) and the replica's wall clock at snapshot
+    # time (`ts=`). A hedged or retried response can deliver an OLD
+    # report after a newer one — the fleet aggregator drops those by
+    # seq, and grossly stale retransmits by wall clock. -1 / 0.0 =
+    # legacy report (always accepted; pre-telemetry replicas keep
+    # working byte-identically).
+    seq: int = -1
+    wall_ts: float = 0.0
     # Stamped by the RECEIVER (gateway clock): reports age out rather
     # than mislead — a 30 s old "idle" beats routing storms.
     ts: float = field(default_factory=time.monotonic)
@@ -79,6 +88,10 @@ class LoadReport:
             f"q={self.queue_depth} a={self.active_slots} "
             f"m={self.max_slots} kvf={self.kv_free_frac:.3f}"
         )
+        if self.seq >= 0:
+            out += f" sq={self.seq}"
+        if self.wall_ts > 0.0:
+            out += f" ts={self.wall_ts:.3f}"
         if self.role != "both":
             # One char on the wire; absent = "both" (monolithic replicas
             # and pre-disaggregation gateways stay byte-identical).
@@ -127,6 +140,8 @@ class LoadReport:
             adapters=adapters,
             role=role,
             transfer_queue=max(0, int(kv.get("tq", 0))),
+            seq=int(kv.get("sq", -1)),
+            wall_ts=max(0.0, kv.get("ts", 0.0)),
         )
 
     @classmethod
@@ -144,4 +159,6 @@ class LoadReport:
             ),
             role=str(snap.get("role", "both") or "both"),
             transfer_queue=max(0, int(snap.get("transfer_queue_depth", 0))),
+            seq=int(snap.get("load_seq", -1)),
+            wall_ts=max(0.0, float(snap.get("load_ts", 0.0))),
         )

@@ -39,6 +39,7 @@ import aiohttp
 from aiohttp import web
 
 from substratus_tpu.gateway.balancer import Balancer, Replica
+from substratus_tpu.gateway.fleet import FleetAggregator
 from substratus_tpu.gateway.limiter import (
     DEADLINE_HEADER,
     KeyedLimiter,
@@ -155,13 +156,23 @@ class Gateway:
     """Router state: balancer + limiter + the shared client session."""
 
     def __init__(self, urls, cfg: Optional[GatewayConfig] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, authorizer=None):
         self.cfg = cfg or GatewayConfig()
         self.balancer = Balancer(
             urls, max_inflight=self.cfg.max_inflight,
             backoff_base=self.cfg.backoff_base,
             backoff_cap=self.cfg.backoff_cap, seed=seed,
         )
+        # Fleet telemetry (gateway/fleet.py): every accepted load
+        # report lands in per-replica ring-buffer time series with
+        # EWMA-smoothed sustained signals — /debug/fleetz, the
+        # substratus_fleet_* gauges, and FleetSignals (the autoscaler
+        # input contract) all read from here.
+        self.fleet = FleetAggregator()
+        # /debug/* RBAC gate, same contract as the server's
+        # (observability/authz.py MetricsAuthorizer); None = open
+        # (local dev).
+        self.authorizer = authorizer
         self.limiter = KeyedLimiter(self.cfg.rate, self.cfg.burst)
         # Per-adapter quotas (multi-tenant fairness, ISSUE 6 follow-up):
         # keyed by the routed `model`/adapter id, so one tenant's burst
@@ -222,7 +233,13 @@ class Gateway:
             return False
         except (json.JSONDecodeError, aiohttp.ContentTypeError):
             return False
-        self.balancer.observe_report(rep, LoadReport.from_snapshot(snap))
+        report = LoadReport.from_snapshot(snap)
+        # The fleet aggregator is the ordering authority (sq=/ts=
+        # dedupe): a report it drops as stale/out-of-order must not
+        # steer routing either. The full /loadz body rides along — it
+        # carries the SLO sketches the header is too small for.
+        if self.fleet.record(rep.url, report, snapshot=snap):
+            self.balancer.observe_report(rep, report)
         self.balancer.observe_success(rep)
         return True
 
@@ -231,7 +248,9 @@ class Gateway:
     def _learn(self, rep: Replica, headers) -> None:
         raw = headers.get(LOAD_HEADER)
         if raw:
-            self.balancer.observe_report(rep, LoadReport.from_header(raw))
+            report = LoadReport.from_header(raw)
+            if self.fleet.record(rep.url, report):
+                self.balancer.observe_report(rep, report)
 
     def _fail(self, rep: Replica) -> None:
         window = self.balancer.observe_failure(rep)
@@ -289,12 +308,46 @@ def build_gateway_app(gw: Gateway) -> web.Application:
     async def metrics(request: web.Request) -> web.Response:
         for rep in gw.balancer.replicas.values():
             gw._set_inflight(rep)
+        # Refresh the fleet rollup gauges (replica counts by role) and
+        # run dead-replica eviction so the scrape never reports a
+        # scaled-down replica's last load as current.
+        gw.fleet.signals()
         return web.Response(
             body=METRICS.render().encode(),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
             },
         )
+
+    async def _authorize_debug(request: web.Request) -> None:
+        """Gate /debug/* with the same RBAC check as the server's debug
+        plane (TokenReview + SubjectAccessReview through gw.authorizer);
+        open when no authorizer is configured (local dev)."""
+        if gw.authorizer is None:
+            return
+        loop = asyncio.get_running_loop()
+        status, reason = await loop.run_in_executor(
+            None, gw.authorizer.allow,
+            request.headers.get("Authorization"),
+        )
+        if status == 200:
+            return
+        if status == 401:
+            raise web.HTTPUnauthorized(
+                text=reason, headers={"WWW-Authenticate": "Bearer"}
+            )
+        if status == 403:
+            raise web.HTTPForbidden(text=reason)
+        raise web.HTTPInternalServerError(text=reason)
+
+    @routes.get("/debug/fleetz")
+    async def fleetz(request: web.Request) -> web.Response:
+        """Fleet telemetry (gateway/fleet.py): per-replica ring-buffer
+        load series, EWMA sustained signals, SLO percentiles, and the
+        fleet rollup — the rendered form of the FleetSignals contract
+        the controller autoscaler consumes."""
+        await _authorize_debug(request)
+        return web.json_response(gw.fleet.snapshot())
 
     @routes.get("/v1/models")
     async def models(request: web.Request) -> web.Response:
@@ -438,6 +491,10 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             if isinstance(result, _ReplicaShed):
                 tried = tried + (rep.url,)
                 shed_response = result.response
+                # Sustained shed rate per replica (gateway/fleet.py):
+                # overload evidence the autoscaler reads once queue
+                # bounds keep queue-depth EWMAs flat.
+                gw.fleet.record_shed(rep.url)
                 continue
             if isinstance(result, _StreamBroken):
                 # Bytes already reached the client: the stream was ended

@@ -50,8 +50,17 @@ from substratus_tpu.observability.events import (  # noqa: F401
     EventRecorder,
 )
 from substratus_tpu.observability.health import serve_health  # noqa: F401
+from substratus_tpu.observability.sketch import (  # noqa: F401
+    Sketch,
+    SLOTracker,
+)
+from substratus_tpu.observability.timeline import (  # noqa: F401
+    BUBBLE_CAUSES,
+    StepTimeline,
+)
 
 __all__ = [
+    "BUBBLE_CAUSES",
     "EVENTS",
     "EventRecorder",
     "LATENCY_BUCKETS",
@@ -60,8 +69,11 @@ __all__ = [
     "THROUGHPUT_BUCKETS",
     "Histogram",
     "Metrics",
+    "SLOTracker",
+    "Sketch",
     "Span",
     "SpanContext",
+    "StepTimeline",
     "Tracer",
     "context_from_env",
     "current_trace_id",

@@ -241,6 +241,16 @@ class Metrics:
                 out[ls] = {"buckets": buckets, "sum": h.sum, "count": h.count}
             return out
 
+    def remove(self, name: str, labels: Labels = "") -> None:
+        """Drop ONE series (the family's declaration stays). For
+        replica-labeled gauges whose replica left the fleet
+        (gateway/fleet.py eviction) — a dead replica's last value would
+        otherwise be scraped forever as if it were current."""
+        key = (name, _labelstr(labels))
+        with self._lock:
+            self.counters.pop(key, None)
+            self._hists.pop(key, None)
+
     def reset(self) -> None:
         """Drop every series and declaration (test isolation)."""
         with self._lock:

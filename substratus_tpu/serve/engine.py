@@ -19,6 +19,7 @@ scheduler thread owns all device state — no locks around jax values.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -34,6 +35,8 @@ import numpy as np
 from substratus_tpu.models import llama
 from substratus_tpu.models.llama import LlamaConfig, Params
 from substratus_tpu.observability.metrics import METRICS, RATIO_BUCKETS
+from substratus_tpu.observability.sketch import SLOTracker
+from substratus_tpu.observability.timeline import StepTimeline
 from substratus_tpu.observability.tracing import (
     SpanContext,
     current_trace_id,
@@ -198,6 +201,12 @@ class EngineConfig:
     # and with spec_k (a speculative round needs a settled batch).
     # False forces the synchronous scheduler — the escape hatch.
     overlap: Optional[bool] = None
+    # SLO thresholds (observability/sketch.py): emits over budget
+    # increment substratus_slo_burn_total{slo=...}, and the mergeable
+    # percentile sketches ride load_snapshot() so the gateway's fleet
+    # aggregator (gateway/fleet.py) rolls them up fleet-wide.
+    slo_ttft_s: float = 2.0
+    slo_inter_token_s: float = 0.25
 
 
 @dataclass
@@ -593,6 +602,31 @@ class Engine:
         # event path carries first-token latency).
         self._wake = threading.Event()
         self._idle_wait_s = 0.05
+
+        # Step timeline + SLO telemetry (observability/timeline.py,
+        # observability/sketch.py): one bounded flight recorder per
+        # engine (written only by the scheduler thread; /debug/stepz
+        # and the bench read it), one SLO tracker fed from _emit whose
+        # sketches ride load_snapshot() to the gateway's fleet
+        # aggregator. The per-iteration accumulators below are
+        # scheduler-thread-only scratch, reset at each loop top.
+        self.timeline = StepTimeline()
+        self.slo = SLOTracker({
+            "ttft": ec.slo_ttft_s,
+            "inter_token": ec.slo_inter_token_s,
+        })
+        # Per-replica monotonic load-report sequence (gateway dedupe of
+        # hedged/retried report deliveries): itertools.count is
+        # atomic under the GIL, and load_snapshot() is called from
+        # HTTP handler threads concurrently.
+        self._load_seq = itertools.count(1)
+        self._tl_flush_s = 0.0
+        self._tl_flush_reasons: List[str] = []
+        self._tl_dispatch_s = 0.0
+        self._tl_drain_s = 0.0
+        self._tl_drain_off_s = 0.0
+        self._tl_pool_dry = False
+        self._tl_iter_t0 = 0.0
 
         self._decode_fn = self._build_decode()
         self._sample1_fn = self._build_first_sample()
@@ -1166,6 +1200,10 @@ class Engine:
                 # adapter pin drops too — re-admission re-acquires.
                 self._release_adapter_pin(req)
                 self._resume.insert(0, req)
+                # Timeline: this iteration's admission time was spent
+                # waiting on pages, not prefilling — attribute the
+                # bubble to capacity (pool_dry), not host speed.
+                self._tl_pool_dry = True
                 break
             admitted += 1
         self.stats["max_active"] = max(
@@ -1201,6 +1239,7 @@ class Engine:
             if not self._install_migration(mig):
                 self._release_adapter_pin(mig.req)
                 self._resume_migrations.insert(0, mig)
+                self._tl_pool_dry = True  # held for pages, same bubble
                 break
             admitted += 1
         return admitted
@@ -1692,7 +1731,12 @@ class Engine:
         METRICS.inc(
             "substratus_serve_pipeline_flushes_total", {"reason": reason}
         )
+        t_flush = time.perf_counter()
         self._drain(pending)
+        # Timeline bubble accounting: a flush's drain is host work the
+        # pipeline could NOT hide (the device sits settled through it).
+        self._tl_flush_s += time.perf_counter() - t_flush
+        self._tl_flush_reasons.append(reason)
         # The batch is settled; the next dispatch feeds host tokens for
         # every slot (on-device feedback resumes with the step after).
         self._dev_tokens = None
@@ -1709,12 +1753,16 @@ class Engine:
         check then sees dt >= floor and never double-sleeps."""
         t_step = time.perf_counter()
         pending = self._dispatch()
+        self._tl_dispatch_s = time.perf_counter() - t_step
         if pending is None:
             return
         dt_step = time.perf_counter() - t_step
         if self.ec.step_floor_s > dt_step:
             time.sleep(self.ec.step_floor_s - dt_step)
+        t_drain = time.perf_counter()
         self._drain(pending)
+        self._tl_drain_off_s = t_drain - self._tl_iter_t0
+        self._tl_drain_s = time.perf_counter() - t_drain
 
     def _step_overlapped(self) -> None:
         """One pipelined iteration: launch step N, then run step N-1's
@@ -1730,10 +1778,13 @@ class Engine:
         # previous step itself, and draining it again here would emit
         # duplicate tokens.
         launched = self._dispatch()
+        self._tl_dispatch_s = time.perf_counter() - t_step
         prev, self._pending = self._pending, launched
         if prev is not None:
             t_drain = time.perf_counter()
             self._drain(prev)
+            self._tl_drain_off_s = t_drain - self._tl_iter_t0
+            self._tl_drain_s = time.perf_counter() - t_drain
             if self._pending is not None:
                 # Host work actually hidden under an in-flight step —
                 # the overlapped scheduler's win, exported so operators
@@ -1953,10 +2004,12 @@ class Engine:
                     "substratus_serve_inter_token_seconds",
                     now - req.last_emit_ts,
                 )
+                self.slo.observe("inter_token", now - req.last_emit_ts)
             elif req.submit_ts:
                 METRICS.observe(
                     "substratus_serve_ttft_seconds", now - req.submit_ts
                 )
+                self.slo.observe("ttft", now - req.submit_ts)
             req.last_emit_ts = now
             req.out.put(token_id)
             self.slot_tokens[slot].append(token_id)
@@ -1984,15 +2037,30 @@ class Engine:
     def _loop(self):
         try:
             while self._sync_iterate():
+                t_iter = time.perf_counter()
+                # Reset the step-timeline accumulators (observability/
+                # timeline.py): _flush/_dispatch/_drain/_admit fill
+                # them during this iteration; record_iteration below
+                # turns them into one flight-recorder entry with
+                # bubble attribution.
+                self._tl_iter_t0 = t_iter
+                self._tl_flush_s = 0.0
+                self._tl_flush_reasons = []
+                self._tl_dispatch_s = 0.0
+                self._tl_drain_s = 0.0
+                self._tl_drain_off_s = 0.0
+                self._tl_pool_dry = False
                 t_admit = time.perf_counter()
-                if self._admit():
+                admitted = self._admit()
+                admit_s = time.perf_counter() - t_admit
+                if admitted:
                     # Only iterations that boarded someone observe the
                     # admission phase — an idle engine waking on its
                     # empty queue would otherwise flood the histogram
                     # with ~0 s samples.
                     METRICS.observe(
                         "substratus_serve_phase_seconds",
-                        time.perf_counter() - t_admit,
+                        admit_s,
                         {"phase": "admission"},
                     )
                 if not self.active.any():
@@ -2012,9 +2080,10 @@ class Engine:
                         self._wake.wait(timeout=self._idle_wait_s)
                         self._wake.clear()
                     continue
+                n_active = self.active.sum()  # host numpy mirror
                 METRICS.observe(
                     "substratus_serve_batch_occupancy_ratio",
-                    float(self.active.sum()) / self.ec.max_batch,  # sublint: allow[hostsync]: telemetry on the host numpy active mask, no device read
+                    float(n_active) / self.ec.max_batch,
                 )
                 if self.paged:
                     METRICS.observe(
@@ -2043,6 +2112,21 @@ class Engine:
                 if self.ec.step_floor_s > dt_decode:
                     # Simulated device-step latency (see EngineConfig).
                     time.sleep(self.ec.step_floor_s - dt_decode)
+                self.timeline.record_iteration(
+                    t_start=t_iter,
+                    wall_s=time.perf_counter() - t_iter,
+                    admit_s=admit_s,
+                    admitted=admitted,
+                    dispatch_s=self._tl_dispatch_s,
+                    drain_s=self._tl_drain_s,
+                    drain_off_s=self._tl_drain_off_s,
+                    flush_s=self._tl_flush_s,
+                    flush_reasons=self._tl_flush_reasons,
+                    pool_dry=self._tl_pool_dry,
+                    active_slots=n_active,
+                    max_slots=self.ec.max_batch,
+                    configured_floor_s=self.ec.step_floor_s,
+                )
             # Clean stop with a step still in flight (stop() during
             # decode, a gang stop event, server drain): deliver its
             # tokens before the thread exits — consumers of in-flight
@@ -2130,6 +2214,16 @@ class Engine:
             # (also on /metrics as the *_total counters).
             "prefill_tokens": self.stats["prefill_tokens"],
             "prefix_hit_tokens": self.stats["prefix_hit_tokens"],
+            # Report ordering (gateway/fleet.py): per-replica monotonic
+            # sequence + wall clock, compacted to sq=/ts= on the
+            # x-substratus-load header — the fleet aggregator drops
+            # stale/out-of-order deliveries from hedged responses.
+            "load_seq": next(self._load_seq),
+            "load_ts": round(time.time(), 3),
+            # SLO sketches + burn counters (observability/sketch.py):
+            # mergeable fixed-bucket percentile state the gateway rolls
+            # up fleet-wide on every /loadz poll.
+            "slo": self.slo.snapshot(),
         }
         src = self.source
         if src is not None and hasattr(src, "progress"):

@@ -656,6 +656,29 @@ def build_app(state: ServerState) -> web.Application:
             }
         )
 
+    @routes.get("/debug/stepz")
+    async def stepz(request: web.Request) -> web.Response:
+        """Engine step timeline as Chrome-trace JSON (observability/
+        timeline.py): one span per scheduler iteration with admission/
+        drain/flush sub-spans and per-cause pipeline-bubble attribution
+        in the args — save the body and load it in chrome://tracing or
+        Perfetto. `otherData` carries the lifetime bubble totals
+        (substratus_serve_pipeline_bubble_seconds mirrors them as
+        counters) and the floor estimate. Same RBAC gate as the rest
+        of the /debug plane."""
+        await _authorize_debug(request)
+        tl = state.engine.timeline
+        body = tl.chrome_trace()
+        body["otherData"]["bubble"] = tl.bubble_totals()
+        floor = tl.floor_estimate()
+        body["otherData"]["floor_estimate_s"] = (
+            round(floor, 6) if floor is not None else None
+        )
+        body["otherData"]["configured_step_floor_s"] = (
+            state.engine.ec.step_floor_s
+        )
+        return web.json_response(body)
+
     @routes.get("/debug/eventz")
     async def eventz(request: web.Request) -> web.Response:
         """Recent events from the shared recorder (count-deduped, newest

@@ -939,6 +939,18 @@ def test_concurrency_shared_attr_scope_includes_batchgen():
     )
 
 
+def test_concurrency_shared_attr_scope_includes_fleet_and_timeline():
+    """ISSUE 11 coverage pin: the fleet aggregator (event-loop
+    confined) and the step-timeline ring (scheduler-thread writer,
+    debug-endpoint readers) stay under shared-attr scrutiny."""
+    from substratus_tpu.analysis.concurrency import (
+        DEFAULT_SHARED_ATTR_MODULES,
+    )
+
+    assert "gateway/fleet.py" in DEFAULT_SHARED_ATTR_MODULES
+    assert "observability/timeline.py" in DEFAULT_SHARED_ATTR_MODULES
+
+
 # --- protodrift -----------------------------------------------------------
 
 DRIFT_SRC = """
@@ -991,6 +1003,39 @@ def test_protodrift_balanced_header_passes(tmp_path):
         [proto_check()],
     )
     assert active(findings) == []
+
+
+def test_protodrift_kvheader_covers_seq_and_ts_keys():
+    """ISSUE 11 wire-contract pin: the real x-substratus-load ProtoSpec
+    sees the new sq=/ts= ordering keys on BOTH sides — emitted by
+    LoadReport.to_header, parsed by LoadReport.from_header — so
+    dropping either side regresses `make lint`, not just the fleet
+    aggregator's dedupe."""
+    import ast
+    import os
+
+    from substratus_tpu.analysis.protodrift import (
+        _kvheader_emitted,
+        _read_keys,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(
+        repo, "substratus_tpu", "gateway", "loadreport.py"
+    )).read()
+    tree = ast.parse(src)
+    cls = next(
+        n for n in tree.body
+        if isinstance(n, ast.ClassDef) and n.name == "LoadReport"
+    )
+    fns = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    emitted = set(_kvheader_emitted(fns["to_header"]))
+    read = set(_read_keys(fns["from_header"]))
+    assert {"sq", "ts"} <= emitted, sorted(emitted)
+    assert {"sq", "ts"} <= read, sorted(read)
 
 
 def test_protodrift_dict_protocol_both_directions(tmp_path):

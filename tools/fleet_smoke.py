@@ -1,0 +1,120 @@
+"""Fleet telemetry smoke (make fleet-smoke, CI tests workflow).
+
+Two in-process CPU replicas behind the real gateway
+(substratus_tpu/gateway/testing.py — the same harness the chaos test
+drives), routed traffic plus a couple of /loadz poll cycles, then the
+assertions ISSUE 11 promises:
+
+  1. `/debug/fleetz` shows BOTH replicas with a non-empty ring-buffer
+     series, EWMA sustained signals, and accepted sequence numbers;
+  2. the fleet rollup is present and consistent (replica count, roles,
+     occupancy within [0, 1]);
+  3. SLO sketches arrived via the poll path and merge fleet-wide
+     (ttft/inter_token percentiles non-null after traffic);
+  4. the gateway /metrics exposition carries the substratus_fleet_*
+     families.
+
+Exit 0 with {"ok": true, ...} on success; nonzero with the failing
+stage otherwise.
+"""
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def scenario() -> dict:
+    import aiohttp
+
+    from substratus_tpu.gateway.testing import GatewayHarness
+
+    out = {"ok": False, "stage": "start"}
+    h = await GatewayHarness(n_replicas=2).start()
+    try:
+        async with aiohttp.ClientSession() as s:
+
+            async def one(prompt: str, max_tokens: int = 4) -> str:
+                async with s.post(
+                    h.url + "/v1/completions",
+                    json={"prompt": prompt, "max_tokens": max_tokens,
+                          "temperature": 0.0},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    return r.headers["x-substratus-replica"]
+
+            # Stage 1: routed traffic so header reports flow, then a
+            # breath for the /loadz poller (0.2 s interval in the
+            # harness) to ship the SLO sketches too.
+            out["stage"] = "route"
+            await asyncio.gather(*(one(f"warm{i}") for i in range(8)))
+            await asyncio.sleep(1.0)
+
+            out["stage"] = "fleetz"
+            async with s.get(h.url + "/debug/fleetz") as r:
+                assert r.status == 200, await r.text()
+                fz = await r.json()
+            replicas = fz["replicas"]
+            want = {rep.url for rep in h.replicas}
+            assert set(replicas) == want, (
+                f"fleetz replicas {sorted(replicas)} != {sorted(want)}"
+            )
+            for url, row in replicas.items():
+                assert row["series"], f"{url}: empty time series"
+                assert row["reports"] > 0, f"{url}: no accepted reports"
+                assert row["seq"] >= 1, f"{url}: no sequence numbers seen"
+                ewma = row["ewma"]
+                for k in ("queue_depth", "occupancy", "kv_free_frac",
+                          "transfer_queue", "shed_rate"):
+                    assert k in ewma, f"{url}: ewma missing {k}"
+                assert 0.0 <= ewma["occupancy"] <= 1.0
+            out["series_lens"] = {
+                u: len(r["series"]) for u, r in replicas.items()
+            }
+
+            out["stage"] = "rollup"
+            fleet = fz["fleet"]
+            assert fleet["replicas"] == 2, fleet
+            assert fleet["roles"].get("both") == 2, fleet["roles"]
+            assert 0.0 <= fleet["occupancy"] <= 1.0
+            assert 0.0 <= fleet["kv_free_frac"] <= 1.0
+
+            out["stage"] = "slo"
+            slo = fleet["slo"]
+            assert "ttft" in slo and "inter_token" in slo, sorted(slo)
+            assert slo["ttft"]["count"] > 0, "no TTFT samples merged"
+            assert slo["ttft"]["p50_s"] is not None
+            out["slo_ttft_p50_s"] = slo["ttft"]["p50_s"]
+
+            out["stage"] = "metrics"
+            async with s.get(h.url + "/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+            for family in ("substratus_fleet_queue_depth",
+                           "substratus_fleet_occupancy",
+                           "substratus_fleet_replicas",
+                           "substratus_fleet_reports_total"):
+                assert f"\n{family}{{" in text or \
+                    f"\n{family} " in text, f"{family} not exposed"
+
+            out["ok"] = True
+            out["stage"] = "done"
+            return out
+    finally:
+        await h.stop()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = asyncio.run(asyncio.wait_for(scenario(), timeout=300))
+    except Exception as e:  # one JSON line even on failure
+        print(json.dumps({"ok": False, "error": repr(e)}))
+        return 1
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke gateway-bench adapter-bench disagg-bench overlap-bench prefix-bench batchgen-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke autoscale-smoke gateway-bench adapter-bench disagg-bench overlap-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -99,6 +99,16 @@ gateway-smoke:
 # substratus_fleet_* families on /metrics (tools/fleet_smoke.py).
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
+
+# Closed-loop autoscaling smoke (ISSUE 12 acceptance): one in-process
+# replica behind the gateway, the real decision core closing the loop
+# — a load ramp scales the fleet up, sustained idleness drains one
+# replica back out, and EVERY stream issued across both transitions
+# must end [DONE] with no error event (tools/autoscale_smoke.py; the
+# pytest chaos suite drives the same FleetSupervisor and adds the
+# kill-one-replica self-healing leg).
+autoscale-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/autoscale_smoke.py
 
 # Routed-2-replica vs direct throughput/TTFT capture (ISSUE 5
 # acceptance: routed aggregate tok/s >= 1.7x single replica on the

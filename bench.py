@@ -36,6 +36,8 @@ import subprocess
 import sys
 import time
 
+from substratus_tpu.utils.childenv import child_env, run_child
+
 METRIC_UNIT = "tokens/sec/chip"
 
 # Per-config parity targets (decode is bandwidth-bound, so the 70B-derived
@@ -408,18 +410,22 @@ def probe_backend(
     while True:
         attempt += 1
         t0 = time.monotonic()
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True,
-                timeout=min(timeout_s, max(5.0, deadline - t0)),
-            )
-        except subprocess.TimeoutExpired:
+        # Probe child through the SAME env/watchdog construction the
+        # green MULTICHIP dryrun path uses (utils/childenv.py, ROADMAP
+        # item 5): JAX_PLATFORMS inherited for the chip path, hang
+        # classified by the shared watchdog. tests/test_harness_env.py
+        # pins the two paths' equivalence.
+        res = run_child(
+            [sys.executable, "-c", code],
+            timeout_s=min(timeout_s, max(5.0, deadline - t0)),
+            env=child_env(),
+        )
+        if res.hung:
             last = f"backend init hang (> {timeout_s:.0f}s; wedged tunnel?)"
             record(attempt, t0, "hang", last)
         else:
-            if proc.returncode == 0:
-                detail = proc.stdout.strip()
+            if res.rc == 0:
+                detail = res.stdout.strip()
                 record(attempt, t0, "ok", detail)
                 print(
                     f"backend ok (attempt {attempt}, "
@@ -427,7 +433,7 @@ def probe_backend(
                     file=sys.stderr,
                 )
                 return None
-            last = (proc.stderr.strip() or proc.stdout.strip())[-400:]
+            last = (res.stderr.strip() or res.stdout.strip())[-400:]
             record(attempt, t0, "error", last)
             # A child that exits nonzero within seconds is deterministic
             # (missing jax, bad install), not a wedged tunnel — don't burn
@@ -561,11 +567,10 @@ def main() -> int:
         i += 1
         argv = child_argv(batch, cache_len, a.steps, config, a.kv_dtype,
                           quant, a.decode_impl)
-        try:
-            proc = subprocess.run(
-                argv, capture_output=True, text=True, timeout=a.run_timeout,
-            )
-        except subprocess.TimeoutExpired:
+        # Same shared env/watchdog construction as the probe child and
+        # the MULTICHIP dryrun (utils/childenv.py).
+        res = run_child(argv, a.run_timeout, env=child_env())
+        if res.hung:
             last_err = f"measurement hang (> {a.run_timeout:.0f}s)"
             # A hang will not get better at a smaller tier — but the tunnel
             # may recover. Re-probe (short budget) and retry this tier once.
@@ -591,15 +596,15 @@ def main() -> int:
                     i += 1
                 continue
             break
-        sys.stderr.write(proc.stderr)
-        if proc.returncode == 0 and proc.stdout.strip():
+        sys.stderr.write(res.stderr)
+        if res.rc == 0 and res.stdout.strip():
             # Relay the child's JSON line (last stdout line) verbatim.
-            print(proc.stdout.strip().splitlines()[-1])
+            print(res.stdout.strip().splitlines()[-1])
             return 0
         # Classify on the FULL stderr (XLA's OOM dumps append a multi-KB
         # allocation table after the RESOURCE_EXHAUSTED marker); truncate
         # only what gets embedded in the JSON.
-        full_err = proc.stderr.strip() or f"rc={proc.returncode}"
+        full_err = res.stderr.strip() or f"rc={res.rc}"
         last_err = full_err[-800:]
         if looks_oom(full_err):
             print(

@@ -73,6 +73,19 @@ def main() -> int:
     fleet.signals()
     slo = SLOTracker()
     slo.observe("ttft", 5.0)  # over budget: burns
+    # Autoscale plane (controller/autoscale.py): an applied and a
+    # frozen decision so the outcome counter and target gauge render.
+    from substratus_tpu.controller.autoscale import (
+        Autoscaler,
+        AutoscalePolicy,
+        ScaleTargets,
+    )
+
+    scaler = Autoscaler(AutoscalePolicy(
+        sustain_up_s=0.0, up_cooldown_s=0.0,
+    ))
+    scaler.plan(fleet.signals(), ScaleTargets(replicas=1), now=1.0)
+    scaler.plan(None, ScaleTargets(replicas=1), now=2.0)  # frozen
     StepTimeline().record_iteration(
         t_start=0.0, wall_s=0.02, admit_s=0.004, admitted=1,
         dispatch_s=0.001, drain_s=0.01, configured_floor_s=0.015,

@@ -55,6 +55,14 @@ DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     # shared write added later gets flagged, not shipped.
     "gateway/fleet.py",
     "observability/timeline.py",
+    # The autoscale decision core + Server wiring (ISSUE 12): the
+    # reconciler runs on the manager's loop thread today, but the
+    # per-fleet Autoscaler instances hold mutable timing state
+    # (cooldown stamps, sustain windows, seq latches) that a future
+    # second entry point (e.g. a gateway-side caller) would share —
+    # the same unlocked-write scrutiny as the engine catches that on
+    # the PR, not in production.
+    "controller/autoscale.py",
 )
 
 _BLOCKING = {

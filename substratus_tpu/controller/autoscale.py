@@ -1,0 +1,895 @@
+"""Closed-loop fleet autoscaling: the pure decision core + the Server
+reconciler that wires it to the gateway's fleet telemetry (ROADMAP item
+1 — "the last piece between a fleet you size by hand and a fleet that
+sizes itself"; docs/serving.md "Autoscaling").
+
+Split by design:
+
+  * ``Autoscaler.plan(FleetSignals, ScaleTargets, now) -> ScalePlan`` —
+    pure data in/out, no HTTP, no k8s, no jax. Every robustness edge
+    (hysteresis, cooldowns, sustained thresholds, frozen-on-bad-signals,
+    slice snapping, victim choice) is unit-testable with hand-built
+    signals (tests/test_autoscale.py).
+  * ``ServerAutoscaler`` — the k8s wiring: polls the gateway's
+    ``/debug/fleetz`` payload (the rendered FleetSignals contract,
+    gateway/fleet.py), runs the core, and patches ``params.replicas`` /
+    ``params.disaggregated`` tier sizes so the EXISTING
+    ``_reconcile_server`` / ``_reconcile_disaggregated`` paths deploy
+    the change — the autoscaler never builds a Deployment itself.
+  * The in-process apply path for CPU chaos evidence lives in
+    gateway/testing.py (``FleetSupervisor``): same decision core, same
+    plan, applied to live in-process replicas with drain-based removal.
+
+Robustness contract (the ISSUE's framing: a robustness system first):
+
+  * decisions use EWMA-sustained signals held above/below a threshold
+    for a configured duration — never one hot sample;
+  * a hysteresis band separates the up and down thresholds, so a noisy
+    signal random-walking between them yields ZERO decisions;
+  * per-direction cooldowns bound decision frequency, and a scale-up
+    also blocks the next scale-down (a replica just added must get a
+    chance to absorb load before it can be judged idle);
+  * step sizes are bounded (max_step_up / max_step_down);
+  * stale, empty, or poisoned signals FREEZE the plan at the current
+    (last-known-good) targets — a broken sensor must never shrink a
+    loaded fleet. Outcomes land in
+    ``substratus_autoscale_decisions_total{outcome}``.
+
+Scale decisions must be deployable: when the fleet runs on TPU slices,
+targets snap to the accelerator catalog's topology bins
+(``snap_slice``, resources/accelerators.py) — the plan never emits a
+chip count no topology holds (the ParvaGPU admission/placement split:
+deciding *how much* is separate from deciding *a shape the scheduler
+can place*).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+from dataclasses import dataclass, replace
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from substratus_tpu.gateway.fleet import FleetSignals, ReplicaSignals
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.resources.accelerators import (
+    derive_topology,
+    tpu_info,
+)
+
+log = logging.getLogger("substratus.autoscale")
+
+# Autoscaler metric catalog (docs/observability.md "Autoscaling").
+METRICS.describe(
+    "substratus_autoscale_decisions_total",
+    "Autoscale decisions, by outcome (applied = targets changed, "
+    "held = healthy signals but no change, frozen = stale/empty/"
+    "poisoned signals pinned the plan at last-known-good).",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_autoscale_target_replicas",
+    "Current autoscaler replica target, by tier "
+    "(replicas|prefill|decode).",
+    type="gauge",
+)
+
+_OUTCOMES = ("applied", "held", "frozen")
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """A deployable TPU slice: the snapped chip count always names a
+    catalog topology (never a count no slice shape holds)."""
+
+    generation: str
+    topology: str
+    chips: int
+    num_hosts: int
+
+
+def snap_slice(generation: str, chips: int) -> SliceShape:
+    """Snap a raw chip ask to the smallest catalog topology holding it.
+    Raises ValueError for chips <= 0 or beyond the generation's largest
+    slice — an undeployable ask must fail loudly, not deploy weirdly."""
+    if chips <= 0:
+        raise ValueError(f"chips {chips} invalid (must be >= 1)")
+    info = tpu_info(generation)
+    topo = derive_topology(generation, chips)
+    total = info.topologies[topo]
+    num_hosts = (
+        1 if total <= info.chips_per_host
+        else total // info.chips_per_host
+    )
+    return SliceShape(
+        generation=info.generation, topology=topo, chips=total,
+        num_hosts=num_hosts,
+    )
+
+
+@dataclass(frozen=True)
+class ScaleTargets:
+    """The fleet's current declared size. Monolithic fleets use
+    ``replicas``; disaggregated fleets use the two tier fields (and
+    ``replicas`` is ignored). The plan returns the same shape."""
+
+    replicas: int = 1
+    prefill: int = 0
+    decode: int = 0
+
+    @property
+    def disaggregated(self) -> bool:
+        return self.prefill > 0 or self.decode > 0
+
+    @property
+    def total(self) -> int:
+        return (
+            self.prefill + self.decode if self.disaggregated
+            else self.replicas
+        )
+
+
+@dataclass(frozen=True)
+class ScalePlan:
+    """One decision. ``outcome`` is the metric label: "applied" means
+    the targets differ from the input (the caller should act),
+    "held" means healthy signals and no change, "frozen" means the
+    inputs were unusable and the targets are pinned at last-known-good.
+    ``victims`` names the replicas a scale-down should drain (lowest
+    sustained occupancy first; never the only member of a role).
+    ``eta_s`` > 0 rides a cold-start scale-up (zero ready replicas):
+    the gateway derives Retry-After from it instead of a bare 503."""
+
+    outcome: str
+    reason: str
+    targets: ScaleTargets
+    victims: Tuple[str, ...] = ()
+    eta_s: float = 0.0
+    slice: Optional[SliceShape] = None
+
+
+@dataclass
+class AutoscalePolicy:
+    """Thresholds and timing for one autoscaled fleet. Defaults are
+    conservative for production; tests and the CPU chaos harness shrink
+    every window to keep wall clock in seconds."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_to_zero: bool = False
+
+    # Scale-up pressure (any sustained condition triggers):
+    up_queue_per_replica: float = 2.0  # EWMA queued reqs per replica
+    up_occupancy: float = 0.85  # mean decode-slot occupancy
+    up_shed_rate: float = 0.5  # fleet sheds/s (user-visible overload)
+    kv_free_floor: float = 0.05  # tightest replica's free KV fraction
+
+    # Scale-down evidence (ALL must hold, sustained):
+    down_occupancy: float = 0.30
+    down_queue_per_replica: float = 0.25
+
+    # Sustained-signal windows + per-direction cooldowns.
+    sustain_up_s: float = 5.0
+    sustain_down_s: float = 15.0
+    idle_zero_s: float = 60.0  # fully-idle time before scale-to-zero
+    up_cooldown_s: float = 10.0
+    down_cooldown_s: float = 30.0
+
+    # Bounded steps: one decision never moves the fleet further than
+    # this (a mis-tuned threshold costs one step per cooldown, not the
+    # whole fleet).
+    max_step_up: int = 2
+    max_step_down: int = 1
+
+    # Degradation: ALL replicas silent longer than this = a dead
+    # aggregator or a partitioned fleet — freeze.
+    stale_after_s: float = 20.0
+
+    # Disaggregated rebalance: sustained transfer-queue depth per
+    # decode replica above this grows the decode tier (the KV-handoff
+    # backlog is the prefill:decode imbalance signal, serve/disagg.py).
+    transfer_queue_per_decode: float = 2.0
+
+    # Placement (optional): when set, every replica is one TPU slice of
+    # this shape and plans carry the snapped SliceShape.
+    tpu_generation: Optional[str] = None
+    chips_per_replica: int = 0
+
+    # Cold start: how long a scale-up from zero takes to first ready
+    # replica (pod schedule + weights load). Rides ScalePlan.eta_s so
+    # the gateway's shed can say "Retry-After: <eta>".
+    cold_start_eta_s: float = 30.0
+
+
+def policy_from_params(auto: Mapping) -> AutoscalePolicy:
+    """params.autoscale (Server CR) -> policy. Unknown keys ignored;
+    camelCase per the CR params convention (docs/container-contract.md)."""
+    p = AutoscalePolicy()
+    keymap = {
+        "min": "min_replicas",
+        "max": "max_replicas",
+        "scaleToZero": "scale_to_zero",
+        "upQueuePerReplica": "up_queue_per_replica",
+        "upOccupancy": "up_occupancy",
+        "upShedRate": "up_shed_rate",
+        "kvFreeFloor": "kv_free_floor",
+        "downOccupancy": "down_occupancy",
+        "downQueuePerReplica": "down_queue_per_replica",
+        "sustainUpSeconds": "sustain_up_s",
+        "sustainDownSeconds": "sustain_down_s",
+        "idleZeroSeconds": "idle_zero_s",
+        "upCooldownSeconds": "up_cooldown_s",
+        "downCooldownSeconds": "down_cooldown_s",
+        "maxStepUp": "max_step_up",
+        "maxStepDown": "max_step_down",
+        "staleAfterSeconds": "stale_after_s",
+        "transferQueuePerDecode": "transfer_queue_per_decode",
+        "tpuGeneration": "tpu_generation",
+        "chipsPerReplica": "chips_per_replica",
+        "coldStartEtaSeconds": "cold_start_eta_s",
+    }
+    for key, attr in keymap.items():
+        if key in auto:
+            kind = type(getattr(p, attr))
+            raw = auto[key]
+            if kind is bool:
+                setattr(p, attr, bool(raw))
+            elif kind is int:
+                setattr(p, attr, int(raw))
+            elif kind is float:
+                setattr(p, attr, float(raw))
+            else:
+                setattr(p, attr, str(raw) if raw is not None else None)
+    if p.min_replicas < 0 or p.max_replicas < max(1, p.min_replicas):
+        raise ValueError(
+            f"autoscale bounds invalid: min={p.min_replicas} "
+            f"max={p.max_replicas}"
+        )
+    return p
+
+
+def signals_from_snapshot(payload: Mapping) -> FleetSignals:
+    """Parse the /debug/fleetz JSON payload back into the typed
+    FleetSignals contract. Raises ValueError on a structurally garbled
+    payload — the caller treats that as a poisoned sensor (freeze),
+    never as an empty fleet (which would invite a scale-down)."""
+    if not isinstance(payload, Mapping):
+        raise ValueError("fleetz payload is not a mapping")
+    reps_raw = payload.get("replicas")
+    fleet = payload.get("fleet")
+    if not isinstance(reps_raw, Mapping) or not isinstance(fleet, Mapping):
+        raise ValueError("fleetz payload missing replicas/fleet")
+    rows: List[ReplicaSignals] = []
+    for url, row in sorted(reps_raw.items()):
+        if not isinstance(row, Mapping):
+            raise ValueError(f"replica row {url!r} is not a mapping")
+        ewma = row.get("ewma") or {}
+        if not isinstance(ewma, Mapping):
+            raise ValueError(f"replica row {url!r} ewma is not a mapping")
+        rows.append(ReplicaSignals(
+            url=str(url),
+            role=str(row.get("role", "both") or "both"),
+            samples=int(row.get("reports", 0)),
+            age_s=float(row.get("age_s", float("inf"))),
+            seq=int(row.get("seq", -1)),
+            queue_depth=float(ewma.get("queue_depth", 0.0)),
+            occupancy=float(ewma.get("occupancy", 0.0)),
+            kv_free_frac=float(ewma.get("kv_free_frac", 1.0)),
+            transfer_queue=float(ewma.get("transfer_queue", 0.0)),
+            shed_rate=float(ewma.get("shed_rate", 0.0)),
+        ))
+    roles: Dict[str, int] = {}
+    for r in rows:
+        roles[r.role] = roles.get(r.role, 0) + 1
+    return FleetSignals(
+        ts=float(payload.get("now_mono", 0.0)),
+        replicas=tuple(rows),
+        queue_depth=float(fleet.get("queue_depth", 0.0)),
+        occupancy=float(fleet.get("occupancy", 0.0)),
+        kv_free_frac=float(fleet.get("kv_free_frac", 1.0)),
+        transfer_queue=float(fleet.get("transfer_queue", 0.0)),
+        shed_rate=float(fleet.get("shed_rate", 0.0)),
+        roles=roles,
+    )
+
+
+def pick_victims(
+    signals: FleetSignals, count: int, role: Optional[str] = None
+) -> Tuple[str, ...]:
+    """Choose replicas a scale-down should drain: lowest sustained
+    occupancy (then queue) first — the cheapest streams to wait out.
+    Never picks the only live member of a role: in a disaggregated
+    fleet, draining the last prefill (or decode) replica would strand
+    the other tier with committed work and no peer."""
+    if count <= 0:
+        return ()
+    rows = [
+        r for r in signals.replicas
+        if role is None or r.role == role
+    ]
+    rows.sort(key=lambda r: (r.occupancy, r.queue_depth, r.url))
+    live_roles: Dict[str, int] = {}
+    for r in signals.replicas:
+        live_roles[r.role] = live_roles.get(r.role, 0) + 1
+    victims: List[str] = []
+    for r in rows:
+        if len(victims) >= count:
+            break
+        # "both" replicas are interchangeable; specialized roles must
+        # keep one live copy.
+        if r.role != "both" and live_roles.get(r.role, 0) <= 1:
+            continue
+        live_roles[r.role] = live_roles.get(r.role, 0) - 1
+        victims.append(r.url)
+    return tuple(victims)
+
+
+def _finite(*values: float) -> bool:
+    return all(math.isfinite(v) for v in values)
+
+
+class Autoscaler:
+    """The decision core. Holds only timing state (sustained-signal
+    entry times, cooldown stamps, per-replica seq latches); every
+    ``plan()`` input and output is pure data. One instance per
+    autoscaled fleet (the wiring keys instances by CR)."""
+
+    def __init__(self, policy: Optional[AutoscalePolicy] = None):
+        self.policy = policy or AutoscalePolicy()
+        # Sustained-signal tracking: monotonic time each condition
+        # FIRST became (and stayed) true; None = currently false.
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._rebalance_since: Optional[float] = None
+        # Per-direction cooldown stamps.
+        self._last_up = float("-inf")
+        self._last_down = float("-inf")
+        # Poisoned-signal detection: last accepted seq per replica.
+        # The fleet aggregator already rejects out-of-order deliveries
+        # (with a restart-epoch rule), so a seq that REGRESSES by the
+        # time it reaches us means the sensor chain itself is confused.
+        self._seq_latch: Dict[str, int] = {}
+        self._last_signal_ts = float("-inf")
+
+    # -- health ------------------------------------------------------------
+
+    def _health(
+        self, signals: Optional[FleetSignals], targets: ScaleTargets,
+        now: float,
+    ) -> Optional[str]:
+        """None = usable; otherwise the freeze reason. Degradation
+        contract: a dead aggregator, an all-silent fleet, or poisoned
+        rows must freeze the plan — never shrink a loaded fleet on a
+        broken sensor's word."""
+        if signals is None:
+            return "no_signals"
+        if not signals.replicas:
+            # No telemetry rows while the fleet is supposed to have
+            # replicas = every replica silent (or the aggregator lost
+            # them). With targets at zero this is the HEALTHY idle
+            # state, not a failure.
+            return "empty" if targets.total > 0 else None
+        if signals.ts < self._last_signal_ts:
+            return "poisoned"
+        ages = [r.age_s for r in signals.replicas]
+        if targets.total > 0 and all(
+            a > self.policy.stale_after_s for a in ages
+        ):
+            return "stale"
+        for r in signals.replicas:
+            if not _finite(
+                r.queue_depth, r.occupancy, r.kv_free_frac,
+                r.transfer_queue, r.shed_rate,
+            ):
+                return "poisoned"
+            if (
+                r.queue_depth < 0.0
+                or not (0.0 <= r.occupancy <= 1.0 + 1e-6)
+                or not (0.0 <= r.kv_free_frac <= 1.0 + 1e-6)
+                or r.transfer_queue < 0.0
+                or r.shed_rate < 0.0
+            ):
+                return "poisoned"
+            last = self._seq_latch.get(r.url)
+            if last is not None and 0 <= r.seq < last:
+                return "poisoned"
+        return None
+
+    def _latch(self, signals: FleetSignals) -> None:
+        self._last_signal_ts = max(self._last_signal_ts, signals.ts)
+        latched = set()
+        for r in signals.replicas:
+            if r.seq >= 0:
+                self._seq_latch[r.url] = r.seq
+            latched.add(r.url)
+        # Replicas that left the fleet free their latch (a scaled-down
+        # url reused later starts a fresh epoch).
+        for url in list(self._seq_latch):
+            if url not in latched:
+                del self._seq_latch[url]
+
+    # -- the decision ------------------------------------------------------
+
+    def plan(
+        self,
+        signals: Optional[FleetSignals],
+        targets: ScaleTargets,
+        now: Optional[float] = None,
+        pending: float = 0.0,
+    ) -> ScalePlan:
+        """One decision pass. ``pending`` is demand the fleet telemetry
+        cannot see because no replica exists to report it: the
+        gateway's no-replica/cold-start shed count since the last pass.
+        It is the ONLY signal that can scale up from zero."""
+        now = time.monotonic() if now is None else now
+
+        reason = self._health(signals, targets, now)
+        if reason is not None:
+            # Frozen: sustained-signal timers reset (the next healthy
+            # sample starts a fresh window — half-stale evidence must
+            # not pre-charge a decision).
+            self._up_since = self._down_since = None
+            self._idle_since = self._rebalance_since = None
+            return self._finish(ScalePlan(
+                outcome="frozen", reason=reason, targets=targets,
+            ))
+        if signals is not None:
+            self._latch(signals)
+
+        if targets.total == 0:
+            return self._finish(self._plan_from_zero(
+                targets, now, pending
+            ))
+        assert signals is not None  # health passed with total > 0
+        if targets.disaggregated:
+            return self._finish(
+                self._plan_disagg(signals, targets, now)
+            )
+        return self._finish(self._plan_mono(signals, targets, now))
+
+    def _finish(self, plan: ScalePlan) -> ScalePlan:
+        METRICS.inc(
+            "substratus_autoscale_decisions_total",
+            {"outcome": plan.outcome},
+        )
+        t = plan.targets
+        if t.disaggregated:
+            METRICS.set(
+                "substratus_autoscale_target_replicas", t.prefill,
+                {"tier": "prefill"},
+            )
+            METRICS.set(
+                "substratus_autoscale_target_replicas", t.decode,
+                {"tier": "decode"},
+            )
+        else:
+            METRICS.set(
+                "substratus_autoscale_target_replicas", t.replicas,
+                {"tier": "replicas"},
+            )
+        return plan
+
+    def _snap(self) -> Optional[SliceShape]:
+        pol = self.policy
+        if pol.tpu_generation and pol.chips_per_replica > 0:
+            return snap_slice(pol.tpu_generation, pol.chips_per_replica)
+        return None
+
+    def _plan_from_zero(
+        self, targets: ScaleTargets, now: float, pending: float
+    ) -> ScalePlan:
+        """Scale-to-zero's other half: the fleet is (deliberately) at
+        zero; only gateway-observed demand can wake it."""
+        pol = self.policy
+        if pending <= 0.0:
+            return ScalePlan(
+                outcome="held", reason="at_zero_no_demand",
+                targets=targets,
+            )
+        if now - self._last_up < pol.up_cooldown_s:
+            return ScalePlan(
+                outcome="held", reason="up_cooldown", targets=targets,
+            )
+        self._last_up = now
+        self._idle_since = None
+        step = min(
+            pol.max_step_up,
+            max(pol.min_replicas, 1, math.ceil(
+                pending / max(1.0, pol.up_queue_per_replica)
+            )),
+        )
+        step = min(step, pol.max_replicas)
+        new = (
+            replace(targets, prefill=max(1, step - 1), decode=1)
+            if targets.disaggregated else replace(targets, replicas=step)
+        )
+        return ScalePlan(
+            outcome="applied", reason="cold_start_demand",
+            targets=new, eta_s=pol.cold_start_eta_s, slice=self._snap(),
+        )
+
+    # -- monolithic fleet --------------------------------------------------
+
+    def _up_pressure(
+        self, signals: FleetSignals, n: int
+    ) -> Optional[str]:
+        pol = self.policy
+        if signals.queue_depth / max(1, n) >= pol.up_queue_per_replica:
+            return "queue_depth"
+        if signals.occupancy >= pol.up_occupancy:
+            return "occupancy"
+        if signals.shed_rate >= pol.up_shed_rate:
+            return "shed_rate"
+        if signals.kv_free_frac <= pol.kv_free_floor:
+            return "kv_pressure"
+        return None
+
+    def _down_evidence(self, signals: FleetSignals, n: int) -> bool:
+        pol = self.policy
+        return (
+            signals.occupancy <= pol.down_occupancy
+            and signals.queue_depth / max(1, n)
+            <= pol.down_queue_per_replica
+            and signals.shed_rate <= 0.0
+        )
+
+    def _fully_idle(self, signals: FleetSignals) -> bool:
+        return (
+            signals.queue_depth <= 0.0
+            and signals.occupancy <= 0.01
+            and signals.shed_rate <= 0.0
+            and signals.transfer_queue <= 0.0
+        )
+
+    def _plan_mono(
+        self, signals: FleetSignals, targets: ScaleTargets, now: float
+    ) -> ScalePlan:
+        pol = self.policy
+        n = targets.replicas
+
+        up_reason = self._up_pressure(signals, n)
+        if up_reason is not None:
+            if self._up_since is None:
+                self._up_since = now
+        else:
+            self._up_since = None
+        if self._down_evidence(signals, n):
+            if self._down_since is None:
+                self._down_since = now
+        else:
+            self._down_since = None
+        if self._fully_idle(signals):
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+
+        # Scale up: sustained pressure + cooldown + bounded step.
+        if (
+            up_reason is not None
+            and self._up_since is not None
+            and now - self._up_since >= pol.sustain_up_s
+            and now - self._last_up >= pol.up_cooldown_s
+            and n < pol.max_replicas
+        ):
+            want = n + 1
+            if up_reason == "queue_depth":
+                # Deep backlogs may take a bigger (still bounded) step.
+                want = n + min(
+                    pol.max_step_up,
+                    max(1, math.ceil(
+                        signals.queue_depth
+                        / max(1e-9, pol.up_queue_per_replica * n)
+                    ) - 1),
+                )
+            new_n = min(pol.max_replicas, max(want, n + 1))
+            new_n = min(new_n, n + pol.max_step_up)
+            self._last_up = now
+            self._up_since = None
+            return ScalePlan(
+                outcome="applied", reason=f"up_{up_reason}",
+                targets=replace(targets, replicas=new_n),
+                slice=self._snap(),
+            )
+
+        # Scale to zero: fully idle long enough (opt-in), everything
+        # drains.
+        if (
+            pol.scale_to_zero
+            and self._idle_since is not None
+            and now - self._idle_since >= pol.idle_zero_s
+            and now - self._last_down >= pol.down_cooldown_s
+            and now - self._last_up >= pol.down_cooldown_s
+        ):
+            self._last_down = now
+            self._idle_since = None
+            self._down_since = None
+            return ScalePlan(
+                outcome="applied", reason="scale_to_zero",
+                targets=replace(targets, replicas=0),
+                victims=pick_victims(signals, n),
+            )
+
+        # Scale down: sustained idleness evidence + both-direction
+        # cooldown (a replica the last decision just added gets
+        # down_cooldown_s to absorb load before it can be judged).
+        floor = pol.min_replicas if not pol.scale_to_zero else max(
+            pol.min_replicas, 1
+        )
+        if (
+            self._down_since is not None
+            and now - self._down_since >= pol.sustain_down_s
+            and now - self._last_down >= pol.down_cooldown_s
+            and now - self._last_up >= pol.down_cooldown_s
+            and n > floor
+        ):
+            new_n = max(floor, n - pol.max_step_down)
+            self._last_down = now
+            self._down_since = None
+            return ScalePlan(
+                outcome="applied", reason="down_idle",
+                targets=replace(targets, replicas=new_n),
+                victims=pick_victims(signals, n - new_n),
+                slice=self._snap(),
+            )
+
+        return ScalePlan(outcome="held", reason="in_band", targets=targets)
+
+    # -- disaggregated fleet ----------------------------------------------
+
+    def _tier_rows(
+        self, signals: FleetSignals, role: str
+    ) -> List[ReplicaSignals]:
+        return [r for r in signals.replicas if r.role == role]
+
+    def _plan_disagg(
+        self, signals: FleetSignals, targets: ScaleTargets, now: float
+    ) -> ScalePlan:
+        """Two-tier sizing. The prefill tier scales on admission
+        pressure (queue depth lives there — completions route to
+        prefill, balancer.pick(role=)); the decode tier scales on the
+        transfer-queue backlog (a handoff waiting to ship IS a decode
+        slot shortage, serve/disagg.py). Tiers never scale below one
+        replica: the peer tier's committed work needs a live copy of
+        each role (scale-to-zero is a monolithic-fleet feature)."""
+        pol = self.policy
+        prefill = self._tier_rows(signals, "prefill")
+        decode = self._tier_rows(signals, "decode")
+        n_p, n_d = targets.prefill, targets.decode
+
+        p_queue = sum(r.queue_depth for r in prefill)
+        p_occ = (
+            sum(r.occupancy for r in prefill) / len(prefill)
+            if prefill else 0.0
+        )
+        d_occ = (
+            sum(r.occupancy for r in decode) / len(decode)
+            if decode else 0.0
+        )
+        tq = signals.transfer_queue
+
+        up_p = p_queue / max(1, n_p) >= pol.up_queue_per_replica or (
+            p_occ >= pol.up_occupancy
+        )
+        up_d = tq / max(1, n_d) >= pol.transfer_queue_per_decode or (
+            d_occ >= pol.up_occupancy
+        )
+        if up_p or up_d:
+            if self._up_since is None:
+                self._up_since = now
+        else:
+            self._up_since = None
+        down_ok = (
+            p_occ <= pol.down_occupancy
+            and d_occ <= pol.down_occupancy
+            and p_queue / max(1, n_p) <= pol.down_queue_per_replica
+            and tq <= 0.0
+            and signals.shed_rate <= 0.0
+        )
+        if down_ok:
+            if self._down_since is None:
+                self._down_since = now
+        else:
+            self._down_since = None
+
+        if (
+            (up_p or up_d)
+            and self._up_since is not None
+            and now - self._up_since >= pol.sustain_up_s
+            and now - self._last_up >= pol.up_cooldown_s
+            and n_p + n_d < pol.max_replicas
+        ):
+            budget = min(
+                pol.max_step_up, pol.max_replicas - (n_p + n_d)
+            )
+            add_d = 1 if up_d and budget > 0 else 0
+            add_p = 1 if up_p and budget - add_d > 0 else 0
+            if add_p + add_d > 0:
+                self._last_up = now
+                self._up_since = None
+                return ScalePlan(
+                    outcome="applied",
+                    reason="up_transfer_queue" if up_d else "up_queue_depth",
+                    targets=replace(
+                        targets, prefill=n_p + add_p, decode=n_d + add_d
+                    ),
+                    slice=self._snap(),
+                )
+
+        if (
+            self._down_since is not None
+            and now - self._down_since >= pol.sustain_down_s
+            and now - self._last_down >= pol.down_cooldown_s
+            and now - self._last_up >= pol.down_cooldown_s
+            and n_p + n_d > max(2, pol.min_replicas)
+        ):
+            # Shrink the idler tier (one step), never below one each.
+            shrink_decode = d_occ <= p_occ and n_d > 1
+            if not shrink_decode and n_p <= 1:
+                shrink_decode = n_d > 1
+            if shrink_decode and n_d > 1:
+                new = replace(targets, decode=n_d - 1)
+                victims = pick_victims(signals, 1, role="decode")
+            elif n_p > 1:
+                new = replace(targets, prefill=n_p - 1)
+                victims = pick_victims(signals, 1, role="prefill")
+            else:
+                return ScalePlan(
+                    outcome="held", reason="tier_floor", targets=targets
+                )
+            self._last_down = now
+            self._down_since = None
+            return ScalePlan(
+                outcome="applied", reason="down_idle", targets=new,
+                victims=victims, slice=self._snap(),
+            )
+
+        return ScalePlan(outcome="held", reason="in_band", targets=targets)
+
+
+# ---------------------------------------------------------------------------
+# k8s wiring
+
+
+def targets_from_params(params: Mapping) -> ScaleTargets:
+    """Server CR params -> current declared targets (the same fields
+    _reconcile_server/_reconcile_disaggregated read)."""
+    disagg = params.get("disaggregated")
+    if disagg:
+        counts = disagg if isinstance(disagg, Mapping) else {}
+        return ScaleTargets(
+            replicas=0,
+            prefill=max(1, int(counts.get("prefill", 1))),
+            decode=max(1, int(counts.get("decode", 1))),
+        )
+    return ScaleTargets(replicas=int(params.get("replicas", 1)))
+
+
+def params_patch(plan: ScalePlan, params: Mapping) -> Dict:
+    """The params mutation a plan implies — returned as a fresh dict so
+    the caller patches a freshly-read CR (optimistic concurrency)."""
+    out = dict(params)
+    t = plan.targets
+    if t.disaggregated:
+        out["disaggregated"] = {"prefill": t.prefill, "decode": t.decode}
+    else:
+        out["replicas"] = t.replicas
+    return out
+
+
+class ServerAutoscaler:
+    """Server reconciler closing the loop: fleet telemetry in, params
+    patch out. Registered AFTER ServerReconciler (controller/
+    manager_main.py) so a patched spec re-enqueues the deploy pass.
+
+    ``fetch`` is injectable for tests; the default GETs the gateway's
+    ``/debug/fleetz`` through the front Service (the controller runs
+    in-cluster) and parses it with ``signals_from_snapshot``. Any fetch
+    or parse failure is a dead/poisoned sensor: the core freezes and
+    the CR keeps its current size."""
+
+    def __init__(self, client, fetch=None, interval_s: float = 10.0):
+        self.client = client
+        self.fetch = fetch or self._fetch_fleetz
+        self.interval_s = interval_s
+        self._cores: Dict[Tuple[str, str], Autoscaler] = {}
+        self._pending: Dict[Tuple[str, str], float] = {}
+
+    @staticmethod
+    def _fetch_fleetz(obj) -> Optional[Mapping]:
+        import http.client
+        import json as _json
+        import urllib.request
+
+        md = obj["metadata"]
+        url = (
+            f"http://{md['name']}-server.{md['namespace']}"
+            ".svc.cluster.local:8080/debug/fleetz"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                return _json.loads(resp.read().decode())
+        except (OSError, http.client.HTTPException, ValueError):
+            # URLError/timeouts/refused are OSError; garbled JSON is
+            # ValueError. Every flavor is the same dead-sensor outcome:
+            # None -> the core freezes at last-known-good.
+            return None
+
+    def __call__(self, obj):
+        from substratus_tpu.controller.runtime import Result
+        from substratus_tpu.observability.events import EVENTS
+
+        spec = obj.get("spec") or {}
+        params = spec.get("params") or {}
+        auto = params.get("autoscale")
+        if not isinstance(auto, Mapping):
+            return Result()
+        # Flavors the reconciler cannot resize are skipped loudly once.
+        if params.get("batchGenerate") or params.get("baseModel"):
+            return Result()
+
+        md = obj["metadata"]
+        key = (md["namespace"], md["name"])
+        core = self._cores.get(key)
+        try:
+            policy = policy_from_params(auto)
+        except ValueError as e:
+            EVENTS.emit(
+                "AutoscaleInvalidPolicy", kind="Server",
+                namespace=md["namespace"], name=md["name"],
+                message=str(e), type="Warning",
+            )
+            return Result()
+        if core is None:
+            core = self._cores[key] = Autoscaler(policy)
+        else:
+            core.policy = policy  # CR edits apply next pass
+
+        payload = self.fetch(obj)
+        signals = None
+        if payload is not None:
+            try:
+                signals = signals_from_snapshot(payload)
+            except (ValueError, TypeError):
+                signals = None  # poisoned payload = dead sensor
+
+        targets = targets_from_params(params)
+        plan = core.plan(
+            signals, targets, pending=self._pending.pop(key, 0.0)
+        )
+        if plan.outcome == "frozen":
+            EVENTS.emit(
+                "AutoscaleFrozen", kind="Server",
+                namespace=md["namespace"], name=md["name"],
+                message=plan.reason, type="Warning",
+            )
+        elif plan.outcome == "applied":
+            fresh = self.client.get("Server", md["namespace"], md["name"])
+            fresh_params = (fresh.get("spec") or {}).get("params") or {}
+            fresh["spec"]["params"] = params_patch(plan, fresh_params)
+            self.client.update(fresh)
+            EVENTS.emit(
+                "AutoscaleApplied", kind="Server",
+                namespace=md["namespace"], name=md["name"],
+                message=(
+                    f"{plan.reason}: replicas "
+                    f"{targets.replicas}->{plan.targets.replicas}"
+                    if not plan.targets.disaggregated else
+                    f"{plan.reason}: prefill {targets.prefill}->"
+                    f"{plan.targets.prefill} decode {targets.decode}->"
+                    f"{plan.targets.decode}"
+                ),
+            )
+            log.info(
+                "autoscale %s/%s %s: %s -> %s (victims=%s)",
+                md["namespace"], md["name"], plan.reason, targets,
+                plan.targets, plan.victims,
+            )
+        return Result(requeue_after=self.interval_s)
+
+    def note_pending(self, namespace: str, name: str, n: float) -> None:
+        """Record gateway-observed demand for a scaled-to-zero Server
+        (no replica exists to report it); consumed by the next pass."""
+        key = (namespace, name)
+        self._pending[key] = self._pending.get(key, 0.0) + n

@@ -14,6 +14,7 @@ import os
 from typing import Optional
 
 from substratus_tpu.cloud.base import Cloud, new_cloud
+from substratus_tpu.controller.autoscale import ServerAutoscaler
 from substratus_tpu.controller.build import BuildReconciler
 from substratus_tpu.controller.crs import (
     DatasetReconciler,
@@ -38,6 +39,10 @@ def build_manager(
     ):
         mgr.register(kind, BuildReconciler(client, cloud, sci))
         mgr.register(kind, main_cls(client, cloud, sci))
+    # Closed-loop autoscaling (controller/autoscale.py): runs AFTER the
+    # deploy reconciler so a params patch it writes re-enqueues the
+    # Server and the next pass deploys the new size.
+    mgr.register("Server", ServerAutoscaler(client))
     return mgr
 
 

@@ -36,6 +36,13 @@ class Replica:
         self.max_inflight = max_inflight
         self.inflight = 0
         self.report = LoadReport()
+        # Readiness as the replica itself declares it: the router's
+        # /loadz poller clears this the moment a replica answers 503
+        # (draining or not yet serving), so a scale-down stops
+        # receiving new admissions within ONE poll cycle instead of
+        # waiting out report staleness. Distinct from the circuit: a
+        # draining replica is healthy, it is just leaving.
+        self.ready = True
         self.circuit = CircuitBreaker(
             backoff_base=backoff_base, backoff_cap=backoff_cap
         )
@@ -50,6 +57,7 @@ class Replica:
             "url": self.url,
             "inflight": self.inflight,
             "max_inflight": self.max_inflight,
+            "ready": self.ready,
             "available": self.circuit.available(now),
             "ejected_for_s": max(
                 0.0, round(self.circuit.ejected_until - now, 3)
@@ -104,6 +112,7 @@ class Balancer:
         return [
             r for r in self.replicas.values()
             if r.url not in exclude
+            and r.ready
             and r.circuit.available(now)
             and r.inflight < r.max_inflight
         ]
@@ -171,6 +180,12 @@ class Balancer:
 
     def observe_report(self, rep: Replica, report: LoadReport) -> None:
         rep.report = report
+
+    def observe_ready(self, rep: Replica, ready: bool) -> None:
+        """Poller verdict on the replica's own readiness answer: 200 on
+        /loadz = admittable, 503 = draining/not-ready — out of the
+        eligible set NOW, before any report ages out."""
+        rep.ready = ready
 
     def observe_success(self, rep: Replica) -> None:
         rep.circuit.record_success()

@@ -288,6 +288,24 @@ class FleetAggregator:
         recent = sum(1 for t in sr.shed_times if t > cutoff)
         return recent / self.shed_window_s
 
+    def forget(self, url: str) -> None:
+        """Drop one replica's series (and its gauges) immediately. The
+        autoscale supervisor calls this on drain/replacement — it KNOWS
+        the replica is gone, and waiting out evict_s would keep a
+        removed replica's last load in the rollups the planner reads."""
+        url = url.rstrip("/")
+        sr = self._series.pop(url, None)
+        if sr is None:
+            return
+        for gauge in _GAUGE_OF.values():
+            METRICS.remove(gauge, {"replica": url})
+        METRICS.remove("substratus_fleet_shed_rate", {"replica": url})
+        for name in sr.slo:
+            METRICS.remove(
+                "substratus_fleet_slo_burn",
+                {"replica": url, "slo": name},
+            )
+
     def _evict_dead(self, now: float) -> None:
         """Forget replicas with no accepted report for evict_s: a
         scaled-down or crashed replica must drop out of the rollups
@@ -297,15 +315,7 @@ class FleetAggregator:
             if sr.last_mono is not None
             and now - sr.last_mono > self.evict_s
         ]:
-            sr = self._series.pop(url)
-            for gauge in _GAUGE_OF.values():
-                METRICS.remove(gauge, {"replica": url})
-            METRICS.remove("substratus_fleet_shed_rate", {"replica": url})
-            for name in sr.slo:
-                METRICS.remove(
-                    "substratus_fleet_slo_burn",
-                    {"replica": url, "slo": name},
-                )
+            self.forget(url)
 
     # -- consumption -------------------------------------------------------
 

@@ -75,8 +75,8 @@ METRICS.describe(
 )
 METRICS.describe(
     "substratus_gateway_sheds_total",
-    "Requests shed instead of queued, by reason "
-    "(ratelimit, adapter_quota, deadline, no_replica, saturated).",
+    "Requests shed instead of queued, by reason (ratelimit, "
+    "adapter_quota, deadline, no_replica, saturated, cold_start).",
     type="counter",
 )
 METRICS.describe(
@@ -182,6 +182,37 @@ class Gateway:
         )
         self.session: Optional[aiohttp.ClientSession] = None
         self._poll_task: Optional[asyncio.Task] = None
+        # Cold-start hint (scale-to-zero contract, docs/serving.md
+        # "Autoscaling"): while a scale-up is in flight and no replica
+        # is ready yet, sheds carry Retry-After derived from the plan's
+        # ETA instead of a bare 503 — clients back off just long enough.
+        self._scale_eta_until: Optional[float] = None
+
+    # -- scale-up hint -----------------------------------------------------
+
+    def set_scale_hint(self, eta_s: float,
+                       now: Optional[float] = None) -> None:
+        """A scale-up is in flight (autoscaler/controller): expect the
+        first replica ready in ~eta_s. Overwrites any earlier hint."""
+        now = time.monotonic() if now is None else now
+        self._scale_eta_until = now + max(0.0, eta_s)
+
+    def clear_scale_hint(self) -> None:
+        self._scale_eta_until = None
+
+    def scale_eta_remaining(
+        self, now: Optional[float] = None
+    ) -> Optional[float]:
+        """Seconds until the hinted scale-up lands; None = no live
+        hint (never hinted, or the ETA already passed)."""
+        if self._scale_eta_until is None:
+            return None
+        now = time.monotonic() if now is None else now
+        remaining = self._scale_eta_until - now
+        if remaining <= 0.0:
+            self._scale_eta_until = None
+            return None
+        return remaining
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -224,7 +255,13 @@ class Gateway:
                 rep.url + "/loadz", timeout=timeout
             ) as resp:
                 if resp.status != 200:
-                    return False  # draining/not-ready: steer, don't eject
+                    # Draining/not-ready BY THE REPLICA'S OWN WORD: out
+                    # of the eligible set immediately — a drain-based
+                    # scale-down stops receiving admissions in one poll
+                    # cycle, not after the EWMA/staleness window. Not an
+                    # ejection: the replica is healthy, just leaving.
+                    self.balancer.observe_ready(rep, False)
+                    return False
                 snap = await resp.json()
         except _TRANSPORT_ERRORS:
             # The poller observes, it does not punish: ejection windows
@@ -233,6 +270,7 @@ class Gateway:
             return False
         except (json.JSONDecodeError, aiohttp.ContentTypeError):
             return False
+        self.balancer.observe_ready(rep, True)
         report = LoadReport.from_snapshot(snap)
         # The fleet aggregator is the ordering authority (sq=/ts=
         # dedupe): a report it drops as stale/out-of-order must not
@@ -456,6 +494,12 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                     return await give_up(None)
                 if gw.balancer.saturated():
                     raise gw._shed("saturated", gw.cfg.shed_retry_after)
+                # Zero ready replicas with a scale-up in flight: the
+                # honest answer is "come back when it lands", not a
+                # bare 503 (scale-to-zero cold start).
+                eta = gw.scale_eta_remaining()
+                if eta is not None:
+                    raise gw._shed("cold_start", eta)
                 raise gw._shed("no_replica", gw.cfg.backoff_base)
             if attempt > 0:
                 METRICS.inc("substratus_gateway_hedges_total")

@@ -120,6 +120,29 @@ class InProcessReplica:
                     req.finish_reason = "error"
                     req.out.put(None)
 
+    async def drain(self, grace_s: float = 10.0) -> bool:
+        """Graceful removal, in DRAIN order (the opposite of kill():
+        docs/serving.md "Graceful drain"): readiness flips first
+        (/loadz answers 503 so the gateway's poller stops admitting
+        here within one cycle), in-flight requests — including live
+        SSE streams — run to completion up to grace_s, and only then
+        do the listener and engine go away. Returns True when
+        everything finished inside the deadline."""
+        from substratus_tpu.serve.server import drain as server_drain
+
+        clean = await server_drain(self.state, grace_s=grace_s,
+                                   poll_s=0.02)
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        eng, self.engine = self.engine, None
+        if eng is not None:
+            # stop() flushes the pipeline and delivers in-flight
+            # tokens (PR 10 stop-flush); after a clean drain there are
+            # none left.
+            eng.stop()
+        return clean
+
     async def restart(self) -> None:
         """Pod restart: same address, fresh engine + server."""
         assert self.port, "start() before restart()"
@@ -127,6 +150,199 @@ class InProcessReplica:
 
     async def stop(self) -> None:
         await self.kill()
+
+
+class FleetSupervisor:
+    """The closed autoscale loop on in-process replicas — the CPU apply
+    path for the SAME decision core the controller runs
+    (controller/autoscale.py). The chaos test
+    (tests/test_autoscale.py) and `make autoscale-smoke`
+    (tools/autoscale_smoke.py) drive this class, so CI and local smoke
+    cannot drift.
+
+    Each tick: read the gateway's FleetSignals, run the pure planner,
+    then reconcile the ACTUAL replica set toward the planned target the
+    way a Deployment controller would —
+
+      * scale-up: start fresh InProcessReplicas and add them to the
+        balancer (a cold start from zero first arms the gateway's
+        Retry-After hint from the plan's ETA);
+      * scale-down: drain the plan's victims (readiness drops first via
+        the poller's 503 handling, in-flight SSE streams finish), then
+        remove them;
+      * self-healing: a managed replica that stopped reporting for
+        dead_after_s is replaced with a fresh one, not merely routed
+        around.
+    """
+
+    def __init__(self, harness: "GatewayHarness", policy=None,
+                 dead_after_s: float = 1.5,
+                 drain_grace_s: float = 10.0):
+        from substratus_tpu.controller.autoscale import (
+            Autoscaler,
+            AutoscalePolicy,
+        )
+
+        self.h = harness
+        self.core = Autoscaler(policy or AutoscalePolicy(
+            # Fast-twitch windows for CPU tests: decisions in seconds.
+            sustain_up_s=0.6, sustain_down_s=1.2, up_cooldown_s=1.0,
+            down_cooldown_s=2.0, idle_zero_s=3.0, stale_after_s=5.0,
+            cold_start_eta_s=10.0,
+        ))
+        self.target = len(harness.replicas)
+        self.dead_after_s = dead_after_s
+        self.drain_grace_s = drain_grace_s
+        # Short EWMA halflife so a few seconds of synthetic ramp move
+        # the sustained signals a test can act on (production keeps the
+        # 10 s default).
+        if harness.gateway is not None:
+            harness.gateway.fleet.halflife_s = 0.5
+        self.transitions: list = []  # (kind, detail) audit for asserts
+        self.replaced = 0
+        self.drains_clean = 0
+        self.drains_dirty = 0
+        self._started_at: dict = {}
+        self._last_sheds = self._gateway_sheds()
+        self._next_name = len(harness.replicas)
+        now = __import__("time").monotonic()
+        for rep in harness.replicas:
+            self._started_at[rep.url] = now
+
+    # -- signals the fleet telemetry cannot carry --------------------------
+
+    @staticmethod
+    def _gateway_sheds() -> float:
+        """no_replica/cold_start sheds: demand that arrived while zero
+        replicas were ready — the only scale-from-zero signal."""
+        from substratus_tpu.observability.metrics import METRICS
+
+        total = 0.0
+        for reason in ("no_replica", "cold_start"):
+            total += METRICS.get(
+                "substratus_gateway_sheds_total", {"reason": reason}
+            ) or 0
+        return total
+
+    # -- the loop ----------------------------------------------------------
+
+    async def tick(self):
+        """One reconcile pass; returns the ScalePlan for assertions."""
+        from substratus_tpu.controller.autoscale import ScaleTargets
+
+        gw = self.h.gateway
+        signals = gw.fleet.signals()
+        sheds = self._gateway_sheds()
+        pending, self._last_sheds = sheds - self._last_sheds, sheds
+        plan = self.core.plan(
+            signals, ScaleTargets(replicas=self.target), pending=pending
+        )
+        if plan.outcome == "applied":
+            self.transitions.append((plan.reason, plan.targets.replicas))
+            if plan.targets.replicas > self.target and self.target == 0:
+                # Cold start: tell the gateway how long to ask clients
+                # to wait (scale-to-zero contract).
+                gw.set_scale_hint(plan.eta_s)
+            self.target = plan.targets.replicas
+        await self._reconcile(signals, plan.victims)
+        # Self-healing and scale-up both count as "replicas live";
+        # the hint dies once any replica is routable again.
+        if gw.balancer.eligible() and self.target > 0:
+            gw.clear_scale_hint()
+        return plan
+
+    async def run(self, duration_s: float, interval_s: float = 0.3):
+        import asyncio as _asyncio
+        import time as _time
+
+        deadline = _time.monotonic() + duration_s
+        while _time.monotonic() < deadline:
+            await self.tick()
+            await _asyncio.sleep(interval_s)
+
+    # -- actual -> target reconciliation -----------------------------------
+
+    def _live(self, signals) -> list:
+        """Managed replicas that are alive by the fleet's word: a row
+        younger than dead_after_s, or too recently started to have
+        reported yet (first poll pending)."""
+        import time as _time
+
+        now = _time.monotonic()
+        rows = {r.url: r for r in signals.replicas}
+        live = []
+        for rep in self.h.replicas:
+            row = rows.get(rep.url)
+            fresh_start = (
+                now - self._started_at.get(rep.url, 0.0)
+                < self.dead_after_s * 2
+            )
+            if (row is not None and row.age_s < self.dead_after_s) \
+                    or fresh_start:
+                live.append(rep)
+        return live
+
+    async def _reconcile(self, signals, victims: tuple) -> None:
+        import time as _time
+
+        gw = self.h.gateway
+        live = self._live(signals)
+
+        # Self-healing: anything managed but not live is dead — remove
+        # and replace (the replacement is part of the same pass's
+        # scale-up arithmetic below).
+        for rep in [r for r in self.h.replicas if r not in live]:
+            self.transitions.append(("replace_dead", rep.url))
+            self.replaced += 1
+            gw.balancer.remove(rep.url)
+            gw.fleet.forget(rep.url)
+            await rep.kill()  # idempotent; frees any stranded state
+            self.h.replicas.remove(rep)
+
+        # Scale down: drain victims (plan's choice first, arbitrary
+        # live replicas only if the plan named fewer than the excess),
+        # never below the target.
+        excess = len(self.h.replicas) - self.target
+        if excess > 0:
+            chosen = [
+                r for r in self.h.replicas if r.url in victims
+            ][:excess]
+            for rep in self.h.replicas:
+                if len(chosen) >= excess:
+                    break
+                if rep not in chosen:
+                    chosen.append(rep)
+            for rep in chosen:
+                # Belt and braces: the poller flips this on its next
+                # cycle anyway (503 from /loadz), but the supervisor
+                # knows NOW.
+                known = gw.balancer.replicas.get(rep.url)
+                if known is not None:
+                    gw.balancer.observe_ready(known, False)
+                clean = await rep.drain(grace_s=self.drain_grace_s)
+                if clean:
+                    self.drains_clean += 1
+                else:
+                    self.drains_dirty += 1
+                self.transitions.append(("drain", rep.url))
+                gw.balancer.remove(rep.url)
+                gw.fleet.forget(rep.url)
+                self.h.replicas.remove(rep)
+
+        # Scale up (and dead-replica replacement): fresh replicas on
+        # fresh ports.
+        while len(self.h.replicas) < self.target:
+            name = f"replica{self._next_name}"
+            self._next_name += 1
+            rep = InProcessReplica(
+                name, max_batch=self.h.replicas[0].max_batch
+                if self.h.replicas else 4,
+            )
+            await rep.start()
+            self.h.replicas.append(rep)
+            self._started_at[rep.url] = _time.monotonic()
+            gw.balancer.add(rep.url)
+            self.transitions.append(("start", rep.url))
 
 
 class GatewayHarness:

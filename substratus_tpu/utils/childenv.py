@@ -1,0 +1,114 @@
+"""Shared child-process construction for the hardware harness paths.
+
+ROADMAP item 5 background: ``bench.py``'s decode probe hung at backend
+init for five straight rounds while ``__graft_entry__``'s MULTICHIP
+dryrun ran green in the SAME container — which kills the wedged-tunnel
+theory and localizes the bug to the delta between the two harnesses:
+how each builds its child's environment (``JAX_PLATFORMS`` handling,
+``PYTHONPATH`` / sitecustomize plugin exposure, the XLA host-device
+flag) and how each watches the child (timeout classification). This
+module IS that delta, deleted: both paths construct children through
+``child_env``/``run_child``, and tests/test_harness_env.py pins their
+equivalence so the next hardware session debugs ONE harness path, not
+two that drifted.
+
+Import-light on purpose: no jax, no substratus imports — safe to load
+under a wedged device tunnel (the exact situation it exists for).
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import time
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+
+def merge_host_device_flag(env: dict, n_devices: int) -> None:
+    """Set ``--xla_force_host_platform_device_count=n`` in
+    ``env['XLA_FLAGS']``, REWRITING any existing count (a pre-set wrong
+    count must not win), preserving every other flag."""
+    flags = env.get("XLA_FLAGS", "")
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
+
+def child_env(
+    platform: Optional[str] = None,
+    host_devices: Optional[int] = None,
+    clean_pythonpath: bool = False,
+    base: Optional[Mapping[str, str]] = None,
+) -> dict:
+    """The one env-construction rule for harness children.
+
+    ``platform=None`` inherits the caller's ``JAX_PLATFORMS`` untouched
+    (the bench probe's chip path: the child must see the same backend
+    the capture targets); a string pins it (the dryrun pins ``"cpu"``).
+    ``host_devices`` merges the XLA virtual-device flag.
+    ``clean_pythonpath=True`` clears ``PYTHONPATH`` so a
+    sitecustomize-injected PJRT plugin never loads in the child (the
+    dryrun's sanitization rule)."""
+    env = dict(os.environ if base is None else base)
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+    if host_devices is not None:
+        merge_host_device_flag(env, host_devices)
+    if clean_pythonpath:
+        env["PYTHONPATH"] = ""
+    return env
+
+
+@dataclass
+class ChildResult:
+    """One watched child run. ``hung=True`` means the hard timeout
+    fired and the child was killed — the wedged-tunnel signature both
+    harnesses must classify, never propagate."""
+
+    rc: Optional[int]
+    stdout: str
+    stderr: str
+    elapsed_s: float
+    hung: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.hung and self.rc == 0
+
+
+def run_child(
+    argv: Sequence[str],
+    timeout_s: float,
+    env: Optional[Mapping[str, str]] = None,
+    cwd: Optional[str] = None,
+) -> ChildResult:
+    """THE watchdog: run a child with captured output and a hard
+    wall-clock limit. A timeout returns ``hung=True`` instead of
+    raising (``subprocess.run`` kills the process group on expiry), so
+    callers branch on one classification instead of re-implementing
+    TimeoutExpired handling three subtly different ways."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            list(argv), capture_output=True, text=True,
+            timeout=timeout_s, env=dict(env) if env is not None else None,
+            cwd=cwd,
+        )
+    except subprocess.TimeoutExpired as e:
+        return ChildResult(
+            rc=None,
+            stdout=(e.stdout or b"").decode(errors="replace")
+            if isinstance(e.stdout, bytes) else (e.stdout or ""),
+            stderr=(e.stderr or b"").decode(errors="replace")
+            if isinstance(e.stderr, bytes) else (e.stderr or ""),
+            elapsed_s=time.monotonic() - t0,
+            hung=True,
+        )
+    return ChildResult(
+        rc=proc.returncode, stdout=proc.stdout, stderr=proc.stderr,
+        elapsed_s=time.monotonic() - t0,
+    )

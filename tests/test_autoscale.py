@@ -1,0 +1,746 @@
+"""Closed-loop fleet autoscaling (ISSUE 12): the pure decision core's
+robustness properties (no-flap, cooldowns, frozen-on-bad-signals,
+slice-shape snapping, victim choice, disagg rebalance, scale-to-zero),
+the controller wiring that patches Server params, and THE chaos
+acceptance path — a CPU fleet of in-process replicas behind the real
+gateway scales up under a load ramp, scales down via drain when idle,
+and replaces a killed replica, with zero dropped or mid-stream-errored
+SSE streams across all three transitions (gateway/testing.py
+FleetSupervisor, the same loop `make autoscale-smoke` drives)."""
+import asyncio
+import json
+import random
+
+import pytest
+
+from substratus_tpu.controller.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    ScalePlan,
+    ScaleTargets,
+    params_patch,
+    pick_victims,
+    policy_from_params,
+    signals_from_snapshot,
+    snap_slice,
+    targets_from_params,
+)
+from substratus_tpu.gateway.fleet import (
+    FleetAggregator,
+    FleetSignals,
+    ReplicaSignals,
+)
+from substratus_tpu.gateway.loadreport import LoadReport
+from substratus_tpu.observability.metrics import METRICS
+
+# ---------------------------------------------------------------------------
+# signal builders (hand-rolled FleetSignals — the decision core is pure
+# data in/out, no HTTP, no k8s, no jax)
+
+
+def row(url, occ=0.0, q=0.0, kv=1.0, tq=0.0, shed=0.0, role="both",
+        age=1.0, seq=3):
+    return ReplicaSignals(
+        url=url, role=role, samples=10, age_s=age, seq=seq,
+        queue_depth=q, occupancy=occ, kv_free_frac=kv,
+        transfer_queue=tq, shed_rate=shed,
+    )
+
+
+def sig(rows, ts=0.0):
+    roles = {}
+    for r in rows:
+        roles[r.role] = roles.get(r.role, 0) + 1
+    return FleetSignals(
+        ts=ts,
+        replicas=tuple(rows),
+        queue_depth=sum(r.queue_depth for r in rows),
+        occupancy=(
+            sum(r.occupancy for r in rows) / len(rows) if rows else 0.0
+        ),
+        kv_free_frac=min((r.kv_free_frac for r in rows), default=1.0),
+        transfer_queue=sum(r.transfer_queue for r in rows),
+        shed_rate=sum(r.shed_rate for r in rows),
+        roles=roles,
+    )
+
+
+# ---------------------------------------------------------------------------
+# decision core: hysteresis / no-flap / cooldowns
+
+
+def test_noflap_random_walk_inside_band_yields_zero_decisions():
+    """THE hysteresis property: a noisy signal random-walking anywhere
+    between the down and up thresholds must produce zero decisions, no
+    matter how long it runs."""
+    pol = AutoscalePolicy(
+        up_occupancy=0.85, down_occupancy=0.30,
+        up_queue_per_replica=2.0, down_queue_per_replica=0.25,
+        sustain_up_s=2.0, sustain_down_s=2.0,
+        up_cooldown_s=0.0, down_cooldown_s=0.0,
+    )
+    a = Autoscaler(pol)
+    rng = random.Random(12)
+    targets = ScaleTargets(replicas=3)
+    applied = 0
+    for i in range(600):  # 600 simulated seconds, 1 Hz
+        occ = rng.uniform(0.35, 0.80)  # inside the band
+        q = rng.uniform(0.3 * 3, 1.9 * 3)  # per-replica inside the band
+        s = sig([
+            row("http://a", occ=occ, q=q / 3),
+            row("http://b", occ=occ, q=q / 3),
+            row("http://c", occ=occ, q=q / 3),
+        ], ts=float(i))
+        plan = a.plan(s, targets, now=float(i))
+        if plan.outcome == "applied":
+            applied += 1
+        assert plan.targets == targets
+    assert applied == 0
+
+
+def test_sustained_threshold_not_one_hot_sample():
+    """A single hot sample must not scale; the pressure has to HOLD for
+    sustain_up_s."""
+    a = Autoscaler(AutoscalePolicy(sustain_up_s=5.0, up_cooldown_s=0.0))
+    t = ScaleTargets(replicas=1)
+    hot = sig([row("http://a", occ=0.95, q=6.0)])
+    cold = sig([row("http://a", occ=0.2, q=0.0)])
+    assert a.plan(hot, t, now=0.0).outcome == "held"
+    assert a.plan(cold, t, now=2.0).outcome == "held"  # pressure broke
+    # Pressure resumed at t=3: the sustain window restarts from there.
+    assert a.plan(hot, t, now=3.0).outcome == "held"
+    assert a.plan(hot, t, now=6.0).outcome == "held"  # only 3 s sustained
+    p = a.plan(hot, t, now=8.5)
+    assert p.outcome == "applied" and p.targets.replicas > 1
+
+
+def test_cooldown_enforced_per_direction():
+    pol = AutoscalePolicy(
+        sustain_up_s=1.0, up_cooldown_s=10.0, down_cooldown_s=20.0,
+        sustain_down_s=1.0, max_replicas=8,
+    )
+    a = Autoscaler(pol)
+    hot = lambda n: sig(  # noqa: E731
+        [row(f"http://r{i}", occ=0.95, q=5.0) for i in range(n)]
+    )
+    p = a.plan(hot(1), ScaleTargets(replicas=1), now=0.0)
+    assert p.outcome == "held"
+    p = a.plan(hot(1), ScaleTargets(replicas=1), now=1.5)
+    assert p.outcome == "applied"
+    n = p.targets.replicas
+    # Still hot, but inside the up cooldown: held.
+    for t in (2.0, 5.0, 9.0):
+        assert a.plan(hot(n), ScaleTargets(replicas=n), now=t
+                      ).outcome == "held"
+    p = a.plan(hot(n), ScaleTargets(replicas=n), now=13.0)
+    assert p.outcome == "applied"
+    # Down is blocked by BOTH the down cooldown and the recent up (a
+    # just-added replica gets a chance to absorb load).
+    idle = sig([row(f"http://r{i}", occ=0.0, q=0.0)
+                for i in range(p.targets.replicas)])
+    a2 = a  # same cooldown state
+    for t in (14.5, 20.0, 30.0):
+        assert a2.plan(idle, p.targets, now=t).outcome == "held"
+    p2 = a2.plan(idle, p.targets, now=34.0)
+    assert p2.outcome == "applied" and p2.reason == "down_idle"
+
+
+def test_bounded_step_sizes():
+    pol = AutoscalePolicy(
+        sustain_up_s=0.0, up_cooldown_s=0.0, max_step_up=2,
+        max_replicas=32,
+    )
+    a = Autoscaler(pol)
+    # A gigantic backlog still moves at most max_step_up per decision.
+    deep = sig([row("http://a", occ=1.0, q=500.0)])
+    p = a.plan(deep, ScaleTargets(replicas=1), now=1.0)
+    assert p.outcome == "applied"
+    assert p.targets.replicas <= 1 + pol.max_step_up
+
+
+def test_scale_up_reasons_queue_occupancy_shed_kv():
+    for kwargs, reason in (
+        (dict(q=6.0), "up_queue_depth"),
+        (dict(occ=0.95), "up_occupancy"),
+        (dict(shed=2.0), "up_shed_rate"),
+        (dict(kv=0.01), "up_kv_pressure"),
+    ):
+        a = Autoscaler(AutoscalePolicy(sustain_up_s=0.0, up_cooldown_s=0.0))
+        p = a.plan(sig([row("http://a", **kwargs)]),
+                   ScaleTargets(replicas=1), now=1.0)
+        assert (p.outcome, p.reason) == ("applied", reason), kwargs
+
+
+# ---------------------------------------------------------------------------
+# decision core: degradation contract (frozen on bad signals)
+
+
+def test_frozen_on_stale_signals_never_shrinks_loaded_fleet():
+    """All replicas silent past stale_after_s = a dead sensor chain.
+    Even though the last EWMAs LOOK idle, the plan freezes — a broken
+    sensor must never shrink a loaded fleet."""
+    pol = AutoscalePolicy(
+        stale_after_s=20.0, sustain_down_s=0.0, down_cooldown_s=0.0,
+    )
+    a = Autoscaler(pol)
+    t = ScaleTargets(replicas=4)
+    idle_but_stale = sig([
+        row(f"http://r{i}", occ=0.0, q=0.0, age=120.0) for i in range(4)
+    ])
+    before = METRICS.get(
+        "substratus_autoscale_decisions_total", {"outcome": "frozen"}
+    ) or 0
+    for now in (0.0, 10.0, 3600.0):
+        p = a.plan(idle_but_stale, t, now=now)
+        assert p.outcome == "frozen" and p.reason == "stale"
+        assert p.targets == t  # pinned at last-known-good
+    after = METRICS.get(
+        "substratus_autoscale_decisions_total", {"outcome": "frozen"}
+    )
+    assert after == before + 3
+
+
+def test_frozen_on_empty_and_dead_aggregator():
+    a = Autoscaler(AutoscalePolicy())
+    t = ScaleTargets(replicas=2)
+    assert a.plan(sig([]), t, now=0.0).reason == "empty"
+    assert a.plan(None, t, now=1.0).reason == "no_signals"
+    # Zero targets + zero rows is the HEALTHY scaled-to-zero state.
+    p = a.plan(sig([]), ScaleTargets(replicas=0), now=2.0)
+    assert p.outcome == "held" and p.reason == "at_zero_no_demand"
+
+
+def test_frozen_on_poisoned_signals():
+    a = Autoscaler(AutoscalePolicy())
+    t = ScaleTargets(replicas=2)
+    nan = sig([row("http://a"), row("http://b", occ=float("nan"))])
+    assert a.plan(nan, t, now=0.0).outcome == "frozen"
+    neg = sig([row("http://a", q=-3.0), row("http://b")])
+    assert a.plan(neg, t, now=1.0).outcome == "frozen"
+    # Sequence regression: the fleet aggregator's ordering rules make
+    # seq monotonic per replica; a regression HERE means the sensor
+    # chain is confused (e.g. two aggregators answering in turn).
+    ok = sig([row("http://a", seq=9), row("http://b", seq=9)])
+    assert a.plan(ok, t, now=2.0).outcome == "held"
+    regressed = sig([row("http://a", seq=4), row("http://b", seq=10)])
+    assert a.plan(regressed, t, now=3.0).reason == "poisoned"
+    # Aggregator clock running backwards freezes too.
+    back = sig([row("http://a", seq=11), row("http://b", seq=11)], ts=-5.0)
+    assert a.plan(back, t, now=4.0).reason == "poisoned"
+
+
+def test_frozen_resets_sustain_windows():
+    """Half-stale evidence must not pre-charge a decision: a freeze in
+    the middle of a sustain window restarts the window."""
+    a = Autoscaler(AutoscalePolicy(sustain_up_s=4.0, up_cooldown_s=0.0))
+    t = ScaleTargets(replicas=1)
+    hot = sig([row("http://a", occ=0.95, q=9.0)])
+    assert a.plan(hot, t, now=0.0).outcome == "held"
+    assert a.plan(None, t, now=2.0).outcome == "frozen"
+    # 4+ s since the FIRST hot sample, but the freeze reset the window.
+    assert a.plan(hot, t, now=5.0).outcome == "held"
+    assert a.plan(hot, t, now=9.5).outcome == "applied"
+
+
+# ---------------------------------------------------------------------------
+# decision core: scale-to-zero + cold start
+
+
+def test_scale_to_zero_and_cold_start_demand():
+    pol = AutoscalePolicy(
+        scale_to_zero=True, idle_zero_s=10.0, sustain_down_s=1.0,
+        down_cooldown_s=0.0, up_cooldown_s=0.0, cold_start_eta_s=17.0,
+    )
+    a = Autoscaler(pol)
+    t = ScaleTargets(replicas=1)
+    idle = sig([row("http://a", occ=0.0, q=0.0)])
+    assert a.plan(idle, t, now=0.0).outcome == "held"
+    assert a.plan(idle, t, now=5.0).outcome == "held"  # not idle long enough
+    p = a.plan(idle, t, now=11.0)
+    assert p.outcome == "applied" and p.reason == "scale_to_zero"
+    assert p.targets.replicas == 0
+    assert p.victims == ("http://a",)
+    # At zero with no demand: healthy hold, not frozen.
+    t0 = ScaleTargets(replicas=0)
+    assert a.plan(sig([]), t0, now=20.0).outcome == "held"
+    # Gateway-observed demand (no-replica sheds) wakes the fleet, and
+    # the plan carries the cold-start ETA for Retry-After.
+    p = a.plan(sig([]), t0, now=21.0, pending=3.0)
+    assert p.outcome == "applied" and p.reason == "cold_start_demand"
+    assert p.targets.replicas >= 1
+    assert p.eta_s == 17.0
+
+
+def test_scale_to_zero_disabled_by_default():
+    a = Autoscaler(AutoscalePolicy(
+        sustain_down_s=0.0, down_cooldown_s=0.0, idle_zero_s=0.0,
+    ))
+    idle = sig([row("http://a", occ=0.0, q=0.0)])
+    p = a.plan(idle, ScaleTargets(replicas=1), now=100.0)
+    assert p.outcome == "held"  # min_replicas=1 floor, no zero
+
+
+# ---------------------------------------------------------------------------
+# decision core: slice-shape snapping
+
+
+def test_snap_slice_never_emits_undeployable_chip_count():
+    """Property: for every generation and every chip ask up to the
+    largest slice, the snapped count is a catalog topology's exact
+    size and >= the ask; beyond the largest slice it raises."""
+    from substratus_tpu.resources.accelerators import CATALOG
+
+    for gen, info in CATALOG.items():
+        deployable = set(info.topologies.values())
+        biggest = max(deployable)
+        for chips in range(1, biggest + 1):
+            shape = snap_slice(gen, chips)
+            assert shape.chips in deployable, (gen, chips, shape)
+            assert shape.chips >= chips
+            assert shape.topology in info.topologies
+            # num_hosts consistent with the per-host chip count.
+            assert shape.num_hosts == max(
+                1, shape.chips // info.chips_per_host
+                if shape.chips > info.chips_per_host else 1
+            )
+        with pytest.raises(ValueError):
+            snap_slice(gen, biggest + 1)
+    with pytest.raises(ValueError):
+        snap_slice("v5e", 0)
+    with pytest.raises(ValueError):
+        snap_slice("nope", 4)
+
+
+def test_plan_carries_snapped_slice_shape():
+    a = Autoscaler(AutoscalePolicy(
+        sustain_up_s=0.0, up_cooldown_s=0.0,
+        tpu_generation="v5e", chips_per_replica=5,  # not a bin: snaps to 8
+    ))
+    p = a.plan(sig([row("http://a", q=9.0)]), ScaleTargets(replicas=1),
+               now=1.0)
+    assert p.outcome == "applied"
+    assert p.slice is not None
+    assert (p.slice.chips, p.slice.topology) == (8, "2x4")
+
+
+# ---------------------------------------------------------------------------
+# decision core: victims + disaggregated rebalance
+
+
+def test_pick_victims_lowest_occupancy_and_role_preserving():
+    s = sig([
+        row("http://p1", occ=0.1, role="prefill"),
+        row("http://p2", occ=0.8, role="prefill"),
+        row("http://d1", occ=0.05, role="decode"),
+        row("http://b1", occ=0.02, role="both"),
+    ])
+    # The idlest overall is d1, but it is the ONLY decode replica —
+    # draining it would strand the prefill tier's committed handoffs.
+    assert pick_victims(s, 1) == ("http://b1",)
+    assert pick_victims(s, 2) == ("http://b1", "http://p1")
+    # Role-scoped: within prefill, the idler one; never the last one.
+    assert pick_victims(s, 1, role="prefill") == ("http://p1",)
+    assert pick_victims(s, 5, role="decode") == ()
+
+
+def test_disagg_rebalance_transfer_queue_grows_decode():
+    """transfer_queue is the prefill:decode imbalance signal: KV
+    handoffs waiting to ship mean the decode tier is the bottleneck."""
+    pol = AutoscalePolicy(
+        sustain_up_s=1.0, up_cooldown_s=0.0,
+        transfer_queue_per_decode=2.0,
+    )
+    a = Autoscaler(pol)
+    t = ScaleTargets(replicas=0, prefill=2, decode=1)
+    backed_up = sig([
+        row("http://p1", occ=0.4, role="prefill", tq=3.0),
+        row("http://p2", occ=0.4, role="prefill", tq=2.0),
+        row("http://d1", occ=0.6, role="decode"),
+    ])
+    assert a.plan(backed_up, t, now=0.0).outcome == "held"
+    p = a.plan(backed_up, t, now=1.5)
+    assert p.outcome == "applied" and p.reason == "up_transfer_queue"
+    assert (p.targets.prefill, p.targets.decode) == (2, 2)
+
+
+def test_disagg_down_never_empties_a_tier():
+    pol = AutoscalePolicy(
+        sustain_down_s=0.0, down_cooldown_s=0.0, up_cooldown_s=0.0,
+    )
+    a = Autoscaler(pol)
+    idle = sig([
+        row("http://p1", occ=0.0, role="prefill"),
+        row("http://d1", occ=0.0, role="decode"),
+    ])
+    t = ScaleTargets(replicas=0, prefill=1, decode=1)
+    p = a.plan(idle, t, now=10.0)
+    assert p.outcome == "held"  # 1+1 is the disagg floor
+    # With a second decode replica, the decode tier shrinks first (it
+    # is the idler tier here) and the victim is decode-role.
+    idle3 = sig([
+        row("http://p1", occ=0.3, role="prefill"),
+        row("http://d1", occ=0.05, role="decode"),
+        row("http://d2", occ=0.02, role="decode"),
+    ])
+    t3 = ScaleTargets(replicas=0, prefill=1, decode=2)
+    p = a.plan(idle3, t3, now=20.0)
+    assert p.outcome == "applied"
+    assert (p.targets.prefill, p.targets.decode) == (1, 1)
+    assert p.victims == ("http://d2",)
+
+
+# ---------------------------------------------------------------------------
+# the /debug/fleetz payload -> FleetSignals parser (the wiring's input)
+
+
+def test_signals_from_snapshot_roundtrip_through_fleet_aggregator():
+    fleet = FleetAggregator()
+    for i, url in enumerate(("http://a", "http://b")):
+        for seq in range(3):
+            assert fleet.record(url, LoadReport(
+                queue_depth=i + 1, active_slots=2, max_slots=4,
+                kv_free_frac=0.5, seq=seq,
+            ), now=float(seq))
+    snap = fleet.snapshot(now=3.0)
+    parsed = signals_from_snapshot(snap)
+    direct = fleet.signals(now=3.0)
+    assert {r.url for r in parsed.replicas} == {"http://a", "http://b"}
+    for got, want in zip(parsed.replicas, direct.replicas):
+        assert got.url == want.url and got.seq == want.seq == 2
+        assert got.queue_depth == pytest.approx(want.queue_depth)
+        assert got.occupancy == pytest.approx(want.occupancy)
+    assert parsed.queue_depth == pytest.approx(direct.queue_depth)
+    assert parsed.roles == dict(direct.roles)
+
+
+def test_signals_from_snapshot_rejects_garbage():
+    for payload in (
+        None, [], "x", {}, {"replicas": []},
+        {"replicas": {"u": "not-a-row"}, "fleet": {}},
+        {"replicas": {"u": {"ewma": 3}}, "fleet": {}},
+    ):
+        with pytest.raises((ValueError, TypeError)):
+            signals_from_snapshot(payload)
+
+
+# ---------------------------------------------------------------------------
+# params plumbing + controller wiring (fake apiserver, no jax)
+
+
+def test_policy_and_targets_from_params():
+    pol = policy_from_params({
+        "min": 2, "max": 12, "scaleToZero": True,
+        "upOccupancy": 0.9, "downCooldownSeconds": 45,
+        "tpuGeneration": "v5e", "chipsPerReplica": 4,
+    })
+    assert (pol.min_replicas, pol.max_replicas) == (2, 12)
+    assert pol.scale_to_zero is True
+    assert pol.up_occupancy == 0.9
+    assert pol.down_cooldown_s == 45.0
+    assert (pol.tpu_generation, pol.chips_per_replica) == ("v5e", 4)
+    with pytest.raises(ValueError):
+        policy_from_params({"min": 5, "max": 2})
+
+    assert targets_from_params({"replicas": 3}) == ScaleTargets(replicas=3)
+    assert targets_from_params({"disaggregated": True}) == ScaleTargets(
+        replicas=0, prefill=1, decode=1
+    )
+    assert targets_from_params(
+        {"disaggregated": {"prefill": 2, "decode": 3}}
+    ) == ScaleTargets(replicas=0, prefill=2, decode=3)
+
+    patched = params_patch(
+        ScalePlan(outcome="applied", reason="t",
+                  targets=ScaleTargets(replicas=4)),
+        {"replicas": 1, "modelDtype": "bf16"},
+    )
+    assert patched == {"replicas": 4, "modelDtype": "bf16"}
+    patched = params_patch(
+        ScalePlan(outcome="applied", reason="t",
+                  targets=ScaleTargets(replicas=0, prefill=2, decode=3)),
+        {"disaggregated": True},
+    )
+    assert patched["disaggregated"] == {"prefill": 2, "decode": 3}
+
+
+def _fleetz_payload(rows):
+    """A minimal /debug/fleetz-shaped payload for the wiring tests."""
+    replicas = {}
+    for r in rows:
+        replicas[r.url] = {
+            "role": r.role, "seq": r.seq, "age_s": r.age_s,
+            "reports": r.samples, "sheds": 0,
+            "ewma": {
+                "queue_depth": r.queue_depth, "occupancy": r.occupancy,
+                "kv_free_frac": r.kv_free_frac,
+                "transfer_queue": r.transfer_queue,
+                "shed_rate": r.shed_rate,
+            },
+            "series": [], "slo": {},
+        }
+    s = sig(rows)
+    return {
+        "now_mono": 1.0,
+        "replicas": replicas,
+        "fleet": {
+            "replicas": len(rows), "roles": dict(s.roles),
+            "queue_depth": s.queue_depth, "occupancy": s.occupancy,
+            "kv_free_frac": s.kv_free_frac,
+            "transfer_queue": s.transfer_queue,
+            "shed_rate": s.shed_rate, "slo": {},
+        },
+    }
+
+
+def _server(name="srv", **params):
+    return {
+        "apiVersion": "substratus.ai/v1",
+        "kind": "Server",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"image": "img:s", "params": params},
+    }
+
+
+def test_server_autoscaler_patches_replicas_and_freezes():
+    from substratus_tpu.controller.autoscale import ServerAutoscaler
+    from substratus_tpu.kube.fake import FakeKube
+    from substratus_tpu.observability.events import EVENTS
+
+    client = FakeKube()
+    client.create(_server(
+        replicas=1,
+        autoscale={"min": 1, "max": 4, "sustainUpSeconds": 0,
+                   "upCooldownSeconds": 0},
+    ))
+    payloads = {"current": _fleetz_payload(
+        [row("http://r0", occ=0.95, q=6.0)]
+    )}
+    asc = ServerAutoscaler(
+        client, fetch=lambda obj: payloads["current"], interval_s=7.0
+    )
+    result = asc(client.get("Server", "default", "srv"))
+    assert result.requeue_after == 7.0
+    stored = client.get("Server", "default", "srv")
+    assert stored["spec"]["params"]["replicas"] > 1
+    assert any(
+        e["reason"] == "AutoscaleApplied" for e in EVENTS.recent()
+    )
+
+    # Dead aggregator: fetch fails -> frozen, params untouched, event.
+    before = dict(stored["spec"]["params"])
+    payloads["current"] = None
+    asc(client.get("Server", "default", "srv"))
+    stored = client.get("Server", "default", "srv")
+    assert stored["spec"]["params"]["replicas"] == before["replicas"]
+    frozen = [
+        e for e in EVENTS.recent() if e["reason"] == "AutoscaleFrozen"
+    ]
+    assert frozen and frozen[-1]["message"] == "no_signals"
+
+    # Poisoned payload: unparseable structure is a dead sensor too.
+    payloads["current"] = {"replicas": "garbage", "fleet": {}}
+    asc(client.get("Server", "default", "srv"))
+    assert client.get(
+        "Server", "default", "srv"
+    )["spec"]["params"]["replicas"] == before["replicas"]
+
+
+def test_server_autoscaler_patches_disagg_tiers():
+    from substratus_tpu.controller.autoscale import ServerAutoscaler
+    from substratus_tpu.kube.fake import FakeKube
+
+    client = FakeKube()
+    client.create(_server(
+        name="dsrv",
+        disaggregated={"prefill": 1, "decode": 1},
+        autoscale={"max": 6, "sustainUpSeconds": 0,
+                   "upCooldownSeconds": 0},
+    ))
+    payload = _fleetz_payload([
+        row("http://p", role="prefill", occ=0.4, tq=5.0),
+        row("http://d", role="decode", occ=0.6),
+    ])
+    asc = ServerAutoscaler(client, fetch=lambda obj: payload)
+    asc(client.get("Server", "default", "dsrv"))
+    stored = client.get("Server", "default", "dsrv")
+    assert stored["spec"]["params"]["disaggregated"] == {
+        "prefill": 1, "decode": 2,
+    }
+
+
+def test_server_autoscaler_skips_non_autoscaled_and_bad_policy():
+    from substratus_tpu.controller.autoscale import ServerAutoscaler
+    from substratus_tpu.kube.fake import FakeKube
+    from substratus_tpu.observability.events import EVENTS
+
+    client = FakeKube()
+    client.create(_server(name="plain", replicas=2))
+    client.create(_server(
+        name="bad", replicas=1, autoscale={"min": 9, "max": 2}
+    ))
+    asc = ServerAutoscaler(
+        client, fetch=lambda obj: pytest.fail("must not fetch")
+    )
+    r = asc(client.get("Server", "default", "plain"))
+    assert r.requeue_after is None
+    asc(client.get("Server", "default", "bad"))
+    assert client.get(
+        "Server", "default", "bad"
+    )["spec"]["params"]["replicas"] == 1
+    assert any(
+        e["reason"] == "AutoscaleInvalidPolicy" for e in EVENTS.recent()
+    )
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance path (in-process fleet, real sockets, real jax
+# engines on CPU): ramp -> scale-up, kill -> replace, idle -> drain-down
+# — zero dropped or mid-stream-errored SSE streams across all of it.
+
+
+def test_autoscale_chaos_ramp_kill_drain():
+    import aiohttp
+
+    from substratus_tpu.controller.autoscale import AutoscalePolicy as AP
+    from substratus_tpu.gateway.testing import (
+        FleetSupervisor,
+        GatewayHarness,
+    )
+
+    async def go():
+        h = await GatewayHarness(n_replicas=1, max_batch=2).start()
+        sup = FleetSupervisor(h, policy=AP(
+            min_replicas=1, max_replicas=2,
+            up_queue_per_replica=1.0, up_occupancy=0.8,
+            down_occupancy=0.25, down_queue_per_replica=0.2,
+            sustain_up_s=0.5, sustain_down_s=1.0,
+            up_cooldown_s=1.0, down_cooldown_s=1.5,
+            stale_after_s=6.0, cold_start_eta_s=10.0,
+        ))
+        outcomes = []  # every stream's verdict rides here
+
+        async def stream_one(s, i, max_tokens=10):
+            verdict = {"ok": False, "stage": "connect", "i": i}
+            async with s.post(
+                h.url + "/v1/completions",
+                json={"prompt": f"p{i}", "max_tokens": max_tokens,
+                      "temperature": 0.0, "stream": True},
+            ) as r:
+                verdict["status"] = r.status
+                if r.status != 200:
+                    outcomes.append(verdict)
+                    return
+                lines = []
+                async for raw in r.content:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if line.startswith("data:"):
+                        lines.append(line[5:].strip())
+                payloads = [json.loads(p) for p in lines if p != "[DONE]"]
+                verdict["ok"] = (
+                    bool(lines) and lines[-1] == "[DONE]"
+                    and not any("error" in p for p in payloads)
+                )
+                verdict["stage"] = "done"
+            outcomes.append(verdict)
+
+        async def pump(s, stop, concurrency):
+            """Keep `concurrency` streams in flight until stop is set;
+            every stream's verdict is recorded."""
+            n = 0
+            tasks = set()
+            while not stop.is_set():
+                while len(tasks) < concurrency:
+                    n += 1
+                    tasks.add(asyncio.create_task(stream_one(s, n)))
+                done, tasks = await asyncio.wait(
+                    tasks, return_when=asyncio.FIRST_COMPLETED,
+                    timeout=0.2,
+                )
+            await asyncio.gather(*tasks)
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                # Warm the single replica (compile outside the clock).
+                await stream_one(s, 0, max_tokens=2)
+
+                # -- Phase 1: load ramp -> scale-up ---------------------
+                stop = asyncio.Event()
+                load = asyncio.create_task(pump(s, stop, concurrency=6))
+                for _ in range(60):  # <= 18 s
+                    await sup.tick()
+                    if sup.target >= 2 and len(h.replicas) == 2:
+                        break
+                    await asyncio.sleep(0.3)
+                assert sup.target == 2, sup.transitions
+                assert len(h.replicas) == 2
+                # The ramp keeps flowing while the new replica lands.
+                await asyncio.sleep(1.0)
+                stop.set()
+                await load
+                assert outcomes and all(
+                    o["ok"] for o in outcomes
+                ), [o for o in outcomes if not o["ok"]][:3]
+                ramp_count = len(outcomes)
+
+                # -- Phase 2: kill one replica -> self-healing ----------
+                # Quiesce so the kill cannot catch a committed stream
+                # (routing around brokenness mid-stream is PR 5's chaos
+                # test; THIS one proves replacement).
+                await asyncio.sleep(0.5)
+                victim = h.replicas[0]
+                victim_url = victim.url
+                await victim.kill()
+                replaced_deadline = 60
+                stop2 = asyncio.Event()
+                load2 = asyncio.create_task(pump(s, stop2, concurrency=2))
+                for _ in range(replaced_deadline):
+                    await sup.tick()
+                    if (
+                        sup.replaced >= 1
+                        and len(h.replicas) == 2
+                        and all(r.engine is not None for r in h.replicas)
+                    ):
+                        break
+                    await asyncio.sleep(0.3)
+                assert sup.replaced == 1, sup.transitions
+                assert len(h.replicas) == 2
+                assert victim_url not in [r.url for r in h.replicas] or (
+                    # same port reuse is fine; what matters is a LIVE one
+                    True
+                )
+                await asyncio.sleep(1.0)
+                stop2.set()
+                await load2
+                assert all(o["ok"] for o in outcomes), [
+                    o for o in outcomes if not o["ok"]
+                ][:3]
+
+                # -- Phase 3: idle -> drain-based scale-down ------------
+                for _ in range(80):  # <= 24 s
+                    await sup.tick()
+                    if sup.target == 1 and len(h.replicas) == 1:
+                        break
+                    await asyncio.sleep(0.3)
+                assert sup.target == 1, sup.transitions
+                assert len(h.replicas) == 1
+                assert sup.drains_clean >= 1
+                assert sup.drains_dirty == 0  # streams finished first
+
+                # The fleet still serves after all three transitions.
+                await stream_one(s, 10_000, max_tokens=4)
+                assert all(o["ok"] for o in outcomes)
+                assert len(outcomes) > ramp_count
+
+                # The audited history shows the full story.
+                kinds = [k for k, _ in sup.transitions]
+                assert "start" in kinds and "drain" in kinds
+                assert "replace_dead" in kinds
+                # Decisions were counted by outcome.
+                assert (METRICS.get(
+                    "substratus_autoscale_decisions_total",
+                    {"outcome": "applied"},
+                ) or 0) >= 2
+        finally:
+            await h.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=300))

@@ -383,6 +383,96 @@ def test_gateway_routing_admission_and_shedding():
     asyncio.run(asyncio.wait_for(go(), timeout=300))
 
 
+def test_poller_marks_draining_replica_not_ready_immediately():
+    """Drain-awareness (ISSUE 12): a replica answering 503 on /loadz
+    (draining) drops out of the eligible set on the FIRST poll cycle —
+    not after the report staleness window — so a drain-based
+    scale-down stops receiving new admissions at once. A healthy
+    /loadz answer restores it. Readiness is not ejection: the circuit
+    stays closed throughout."""
+    from substratus_tpu.gateway.testing import GatewayHarness
+
+    async def go():
+        h = await GatewayHarness(n_replicas=2).start()
+        try:
+            victim = h.replicas[0]
+            rep = h.gateway.balancer.replicas[victim.url]
+            assert rep.ready and rep in h.gateway.balancer.eligible()
+
+            # Drain flips /loadz to 503; ONE poll marks not-ready.
+            victim.state.draining = True
+            assert not await h.gateway.poll_replica(rep)
+            assert rep.ready is False
+            assert rep not in h.gateway.balancer.eligible()
+            for _ in range(20):
+                assert h.gateway.balancer.pick() is not rep
+            # Not ejected: draining is healthy behavior.
+            import time as _time
+
+            assert rep.circuit.available(_time.monotonic())
+            assert rep.circuit.consecutive_failures == 0
+
+            # Drain cancelled (or a fresh replica on the same address):
+            # the next healthy poll restores eligibility.
+            victim.state.draining = False
+            assert await h.gateway.poll_replica(rep)
+            assert rep.ready is True
+            assert rep in h.gateway.balancer.eligible()
+        finally:
+            await h.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=300))
+
+
+def test_cold_start_shed_carries_retry_after_eta():
+    """Scale-to-zero cold start (ISSUE 12): zero ready replicas with a
+    scale-up in flight sheds with Retry-After derived from the plan's
+    ETA (reason cold_start) instead of a bare no_replica 503; once the
+    ETA passes without a hint refresh, the shed reverts."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.gateway.router import (
+        Gateway,
+        GatewayConfig,
+        build_gateway_app,
+    )
+
+    async def go():
+        gw = Gateway([], GatewayConfig(poll_interval=0.0))
+        async with TestClient(
+            TestServer(build_gateway_app(gw))
+        ) as client:
+            # No hint: the old contract (no_replica, generic backoff).
+            r = await client.post(
+                "/v1/completions", json={"prompt": "x", "max_tokens": 1}
+            )
+            assert r.status == 503
+            assert (await r.json())["error"]["type"] == "no_replica"
+
+            # Scale-up in flight: Retry-After says when it lands.
+            gw.set_scale_hint(7.0)
+            r = await client.post(
+                "/v1/completions", json={"prompt": "x", "max_tokens": 1}
+            )
+            assert r.status == 503
+            assert (await r.json())["error"]["type"] == "cold_start"
+            assert 1 <= int(r.headers["Retry-After"]) <= 8
+            assert METRICS.get(
+                "substratus_gateway_sheds_total",
+                {"reason": "cold_start"},
+            ) >= 1
+
+            # Expired hint: back to the generic shed.
+            gw.set_scale_hint(0.0)
+            await asyncio.sleep(0.01)
+            r = await client.post(
+                "/v1/completions", json={"prompt": "x", "max_tokens": 1}
+            )
+            assert (await r.json())["error"]["type"] == "no_replica"
+            assert gw.scale_eta_remaining() is None
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
 def test_gateway_chaos_replica_kill_mid_decode():
     """THE acceptance chaos path: kill one of two replicas mid-decode.
     The committed SSE stream ends with a well-formed error event (no

@@ -1,0 +1,152 @@
+"""Bench-probe / MULTICHIP-dryrun harness parity (ROADMAP item 5
+down-payment, ISSUE 12 satellite).
+
+Hardware context: `bench.py`'s decode probe hung at backend init for
+five straight rounds while `__graft_entry__`'s dryrun ran green in the
+SAME container — the bug lives in the drift between the two harnesses'
+child construction (env handling, watchdog). Both now build children
+through `substratus_tpu/utils/childenv.py`; these CPU tests pin that
+shared path and the exact env delta between the two callers, so the
+next hardware session debugs one harness, not two."""
+import inspect
+import os
+import sys
+
+from substratus_tpu.utils import childenv
+
+
+def test_child_env_platform_handling():
+    base = {"JAX_PLATFORMS": "axon", "PYTHONPATH": "/opt/plugins",
+            "HOME": "/root"}
+    # The probe's chip path: inherit EVERYTHING verbatim — the child
+    # must see the same backend the capture targets.
+    inherited = childenv.child_env(base=base)
+    assert inherited == base
+    assert inherited is not base  # a copy; mutating it can't leak back
+    # The dryrun's path: platform pinned, plugins hidden.
+    pinned = childenv.child_env(
+        platform="cpu", clean_pythonpath=True, base=base
+    )
+    assert pinned["JAX_PLATFORMS"] == "cpu"
+    assert pinned["PYTHONPATH"] == ""
+    assert pinned["HOME"] == "/root"
+
+
+def test_merge_host_device_flag_rewrites_not_clobbers():
+    env = {"XLA_FLAGS": "--xla_foo=1 "
+           "--xla_force_host_platform_device_count=2 --xla_bar=0"}
+    childenv.merge_host_device_flag(env, 8)
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].count("host_platform_device_count") == 1
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+    assert "--xla_bar=0" in env["XLA_FLAGS"]
+    # __graft_entry__'s alias IS the shared rule (not a drifted copy).
+    import __graft_entry__ as graft
+
+    env2 = {"XLA_FLAGS": "--xla_foo=1 "
+            "--xla_force_host_platform_device_count=2 --xla_bar=0"}
+    graft._merge_host_device_flag(env2, 8)
+    assert env2 == env
+
+
+def test_probe_and_dryrun_envs_differ_only_in_the_pinned_delta():
+    """The equivalence contract: the bench probe inherits the caller's
+    env verbatim; the dryrun child differs from it ONLY in the three
+    keys its sanitization owns (platform pin, host-device flag, plugin
+    hiding). Any new divergence must show up here as a failure and be
+    added to the pinned delta deliberately."""
+    base = {
+        "JAX_PLATFORMS": "axon", "PYTHONPATH": "/opt/plugins",
+        "TPU_NAME": "tunnel-0", "XLA_FLAGS": "--xla_foo=1",
+    }
+    probe = childenv.child_env(base=base)
+    dryrun = childenv.child_env(
+        platform="cpu", host_devices=8, clean_pythonpath=True, base=base
+    )
+    assert probe == base
+    delta = {
+        k for k in set(probe) | set(dryrun)
+        if probe.get(k) != dryrun.get(k)
+    }
+    assert delta == {"JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH"}
+    assert dryrun["XLA_FLAGS"] == (
+        "--xla_foo=1 --xla_force_host_platform_device_count=8"
+    )
+
+
+def test_both_harnesses_route_through_the_shared_helpers():
+    """Source-level drift guard: bench.py's probe/measurement children
+    and __graft_entry__'s dryrun re-exec must construct children via
+    child_env + run_child — a revert to bare subprocess.run in either
+    harness fails here before it can fail on a chip."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_parity", os.path.join(root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    import __graft_entry__ as graft
+
+    probe_src = inspect.getsource(bench.probe_backend)
+    main_src = inspect.getsource(bench.main)
+    dryrun_src = inspect.getsource(graft._dryrun_subprocess)
+    for src, where in ((probe_src, "probe_backend"),
+                       (main_src, "bench.main"),
+                       (dryrun_src, "_dryrun_subprocess")):
+        assert "run_child(" in src, f"{where} bypasses the watchdog"
+        assert "child_env(" in src, f"{where} bypasses env construction"
+
+
+def test_run_child_watchdog_classifies_hang_error_and_ok():
+    ok = childenv.run_child(
+        [sys.executable, "-c", "print('hi')"], timeout_s=30
+    )
+    assert ok.ok and ok.rc == 0 and ok.stdout.strip() == "hi"
+    err = childenv.run_child(
+        [sys.executable, "-c",
+         "import sys; print('boom', file=sys.stderr); sys.exit(3)"],
+        timeout_s=30,
+    )
+    assert not err.ok and err.rc == 3 and "boom" in err.stderr
+    assert not err.hung
+    hung = childenv.run_child(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        timeout_s=0.5,
+    )
+    assert hung.hung and hung.rc is None and not hung.ok
+    assert hung.elapsed_s < 10.0
+
+
+def test_probe_backend_classifies_through_shared_watchdog(monkeypatch):
+    """bench.probe_backend's simulation knobs, driven in-process: the
+    wedge signature comes back as a classified 'hang' attempt and the
+    deterministic failure as 'error' — through run_child, same as the
+    dryrun path."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_probe", os.path.join(root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setenv("SUBSTRATUS_BENCH_SIM_WEDGE", "1")
+    attempts = []
+    err = bench.probe_backend(
+        timeout_s=1.0, budget_s=2.0, attempts_log=attempts
+    )
+    assert err is not None and "hang" in err
+    assert attempts and attempts[0]["outcome"] == "hang"
+
+    monkeypatch.delenv("SUBSTRATUS_BENCH_SIM_WEDGE")
+    monkeypatch.setenv("SUBSTRATUS_BENCH_SIM_ERROR", "1")
+    attempts = []
+    err = bench.probe_backend(
+        timeout_s=5.0, budget_s=3.0, attempts_log=attempts
+    )
+    assert err is not None
+    assert attempts and attempts[0]["outcome"] == "error"
+    assert "simulated broken backend install" in attempts[0]["detail"]

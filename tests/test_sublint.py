@@ -951,6 +951,18 @@ def test_concurrency_shared_attr_scope_includes_fleet_and_timeline():
     assert "observability/timeline.py" in DEFAULT_SHARED_ATTR_MODULES
 
 
+def test_concurrency_shared_attr_scope_includes_autoscale():
+    """ISSUE 12 coverage pin: the autoscale decision core's mutable
+    timing state (cooldown stamps, sustain windows, seq latches) stays
+    under shared-attr scrutiny alongside the rest of the serving
+    control plane."""
+    from substratus_tpu.analysis.concurrency import (
+        DEFAULT_SHARED_ATTR_MODULES,
+    )
+
+    assert "controller/autoscale.py" in DEFAULT_SHARED_ATTR_MODULES
+
+
 # --- protodrift -----------------------------------------------------------
 
 DRIFT_SRC = """

@@ -1,0 +1,152 @@
+"""Closed-loop autoscaling smoke (make autoscale-smoke, CI tests
+workflow — ISSUE 12 acceptance).
+
+One in-process CPU replica behind the real gateway, supervised by the
+real decision core (controller/autoscale.py) through the same
+FleetSupervisor the pytest chaos suite drives (gateway/testing.py):
+
+  1. a synthetic load ramp pushes sustained queue/occupancy signals
+     over the up threshold -> the loop STARTS a second replica;
+  2. the ramp stops; sustained idleness crosses the down threshold ->
+     the loop DRAINS one replica (readiness drops first, in-flight SSE
+     streams finish) and removes it;
+  3. zero dropped streams: EVERY stream issued across both transitions
+     ended with [DONE] and no error event (asserted, not logged).
+
+Exit 0 with {"ok": true, ...} on success; nonzero with the failing
+stage otherwise.
+"""
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def scenario() -> dict:
+    import aiohttp
+
+    from substratus_tpu.controller.autoscale import AutoscalePolicy
+    from substratus_tpu.gateway.testing import (
+        FleetSupervisor,
+        GatewayHarness,
+    )
+    from substratus_tpu.observability.metrics import METRICS
+
+    out = {"ok": False, "stage": "start"}
+    h = await GatewayHarness(n_replicas=1, max_batch=2).start()
+    sup = FleetSupervisor(h, policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=2,
+        up_queue_per_replica=1.0, up_occupancy=0.8,
+        down_occupancy=0.25, down_queue_per_replica=0.2,
+        sustain_up_s=0.5, sustain_down_s=1.0,
+        up_cooldown_s=1.0, down_cooldown_s=1.5,
+        stale_after_s=6.0,
+    ))
+    outcomes = []
+
+    async def stream_one(s, i, max_tokens=10):
+        verdict = {"ok": False, "i": i}
+        async with s.post(
+            h.url + "/v1/completions",
+            json={"prompt": f"p{i}", "max_tokens": max_tokens,
+                  "temperature": 0.0, "stream": True},
+        ) as r:
+            verdict["status"] = r.status
+            if r.status != 200:
+                outcomes.append(verdict)
+                return
+            lines = []
+            async for raw in r.content:
+                line = raw.decode("utf-8", "replace").strip()
+                if line.startswith("data:"):
+                    lines.append(line[5:].strip())
+            payloads = [json.loads(p) for p in lines if p != "[DONE]"]
+            verdict["ok"] = (
+                bool(lines) and lines[-1] == "[DONE]"
+                and not any("error" in p for p in payloads)
+            )
+        outcomes.append(verdict)
+
+    async def pump(s, stop, concurrency):
+        n = 0
+        tasks = set()
+        while not stop.is_set():
+            while len(tasks) < concurrency:
+                n += 1
+                tasks.add(asyncio.create_task(stream_one(s, n)))
+            _, tasks = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED, timeout=0.2
+            )
+        await asyncio.gather(*tasks)
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            await stream_one(s, 0, max_tokens=2)  # warm/compile
+
+            out["stage"] = "ramp_scale_up"
+            stop = asyncio.Event()
+            load = asyncio.create_task(pump(s, stop, concurrency=6))
+            for _ in range(60):
+                await sup.tick()
+                if sup.target >= 2 and len(h.replicas) == 2:
+                    break
+                await asyncio.sleep(0.3)
+            assert sup.target == 2 and len(h.replicas) == 2, (
+                f"no scale-up: target={sup.target} "
+                f"replicas={len(h.replicas)} {sup.transitions}"
+            )
+            await asyncio.sleep(1.0)
+            stop.set()
+            await load
+            bad = [o for o in outcomes if not o["ok"]]
+            assert not bad, f"dropped streams during ramp: {bad[:3]}"
+            out["ramp_streams"] = len(outcomes)
+
+            out["stage"] = "idle_drain_down"
+            for _ in range(80):
+                await sup.tick()
+                if sup.target == 1 and len(h.replicas) == 1:
+                    break
+                await asyncio.sleep(0.3)
+            assert sup.target == 1 and len(h.replicas) == 1, (
+                f"no drain-down: target={sup.target} "
+                f"replicas={len(h.replicas)} {sup.transitions}"
+            )
+            assert sup.drains_clean >= 1 and sup.drains_dirty == 0, (
+                f"drain was not clean: {sup.drains_clean} clean / "
+                f"{sup.drains_dirty} dirty"
+            )
+
+            out["stage"] = "still_serving"
+            await stream_one(s, 10_000, max_tokens=4)
+            bad = [o for o in outcomes if not o["ok"]]
+            assert not bad, f"dropped streams: {bad[:3]}"
+            out["streams_total"] = len(outcomes)
+            out["transitions"] = sup.transitions
+            out["decisions_applied"] = METRICS.get(
+                "substratus_autoscale_decisions_total",
+                {"outcome": "applied"},
+            )
+
+            out["ok"] = True
+            out["stage"] = "done"
+            return out
+    finally:
+        await h.stop()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = asyncio.run(asyncio.wait_for(scenario(), timeout=300))
+    except Exception as e:  # one JSON line even on failure
+        print(json.dumps({"ok": False, "error": repr(e)}))
+        return 1
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

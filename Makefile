@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke autoscale-smoke gateway-bench adapter-bench disagg-bench overlap-bench prefix-bench batchgen-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke autoscale-smoke gateway-bench adapter-bench disagg-bench overlap-bench spec-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -150,6 +150,20 @@ disagg-bench:
 # (docs/performance.md "Pipeline-bubble attribution").
 overlap-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --overlap \
+	  | $(PY) hack/bench_compare.py --validate -
+
+# Speculation x overlap composition capture (ISSUE 14 acceptance):
+# plain / spec-only / overlap-only / spec+overlap engines on the same
+# repetitive-prompt shape, simulated device step + the overlap leg's
+# per-token host work — the composed engine's aggregate tok/s must
+# beat BOTH single-lever legs (the pipelined spec rounds amortize the
+# floor across accepted drafts while the one-step-ahead dispatch hides
+# the proposal scan + emit work), greedy outputs token-exact across
+# all four engines, and pipeline_flushes_total{reason="spec"} must not
+# move (docs/performance.md "Speculative decoding";
+# tests/test_spec_overlap.py asserts the same invariants in-process).
+spec-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/engine_bench.py --smoke --spec-overlap \
 	  | $(PY) hack/bench_compare.py --validate -
 
 # Shared-prefix KV reuse capture (ROADMAP item 1 evidence): repeated

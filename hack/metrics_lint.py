@@ -47,6 +47,10 @@ def main() -> int:
         "substratus_gateway_inflight", 2, {"replica": "http://r0:8080"}
     )
     METRICS.inc("substratus_gateway_sheds_total", {"reason": "ratelimit"})
+    # Serve-engine speculation plane (serve/engine.py _spec_drain): the
+    # proposed/accepted pair the acceptance-rate recording rule divides.
+    METRICS.inc("substratus_serve_spec_proposed_tokens_total", by=3)
+    METRICS.inc("substratus_serve_spec_accepted_tokens_total", by=2)
     METRICS.inc(
         "substratus_gateway_ejections_total", {"replica": "http://r0:8080"}
     )

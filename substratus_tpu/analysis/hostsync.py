@@ -27,11 +27,16 @@ Deferred-read idiom (the overlapped scheduler, serve/engine.py): the
 engine's decode step is split into ``Engine._dispatch`` (device-only —
 capacity growth, on-device token feedback, the jitted launch) and
 ``Engine._drain`` (the ONE deferred host read plus emits, run while the
-next step occupies the device). The allowed host read therefore lives
-in ``_drain``; any sync reachable from a STALL_ROOTS entry
-(``_dispatch``) is reported as a *pipeline stall* — it would block the
-launch path on device completion and re-serialize the one-step-ahead
-pipeline, which is strictly worse than a sync elsewhere in the loop.
+next step occupies the device). Speculative rounds use the same split
+(``Engine._spec_dispatch`` chains round N+1's inputs off round N's
+device-resident verify output through a jitted accept-mask advance;
+``Engine._spec_drain`` owns the round's one deferred read and the host
+acceptance walk). The allowed host reads therefore live in the drain
+halves; any sync reachable from a STALL_ROOTS entry (``_dispatch``,
+``_spec_dispatch``) is reported as a *pipeline stall* — it would block
+the launch path on device completion and re-serialize the
+one-step-ahead pipeline, which is strictly worse than a sync elsewhere
+in the loop.
 """
 from __future__ import annotations
 
@@ -52,6 +57,7 @@ DEFAULT_ROOTS: Tuple[Tuple[str, str], ...] = (
 # loop-root edge to the dispatch half is ever refactored away.
 STALL_ROOTS: Tuple[Tuple[str, str], ...] = (
     ("serve/engine.py", "Engine._dispatch"),
+    ("serve/engine.py", "Engine._spec_dispatch"),
 )
 
 # The stall walk stops at explicit pipeline-flush methods: a flush IS a

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import math
 import queue
 import threading
 import time
@@ -93,9 +94,10 @@ METRICS.histogram(
 )
 METRICS.describe(
     "substratus_serve_pipeline_flushes_total",
-    "Overlapped-scheduler pipeline flushes by reason (spec|gang|handoff|"
+    "Overlapped-scheduler pipeline flushes by reason (gang|handoff|"
     "drain|preempt): points where the engine must observe a settled "
-    "batch before proceeding.",
+    "batch before proceeding. The historical reason=\"spec\" is retired "
+    "— speculative rounds chain on-device and hold it at zero.",
     type="counter",
 )
 # True counters (monotonic, rate()-able) for prefix-cache effectiveness —
@@ -111,6 +113,21 @@ METRICS.describe(
     "substratus_serve_prefix_hit_tokens_total",
     "Prompt tokens satisfied from shared prefix pages instead of "
     "recompute (paged layout, serve/paged_kv.py).",
+    type="counter",
+)
+# Speculative-decoding effectiveness as true counters (rate()-able): the
+# acceptance ratio accepted/proposed is the lever the adaptive per-stream
+# draft length steers on (docs/performance.md "Speculative decoding").
+METRICS.describe(
+    "substratus_serve_spec_proposed_tokens_total",
+    "Draft tokens proposed to speculative verify rounds (greedy streams "
+    "only; placeholder rows and degraded streams do not count).",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_serve_spec_accepted_tokens_total",
+    "Proposed draft tokens the target model accepted (longest matching "
+    "prefix of each verify round).",
     type="counter",
 )
 
@@ -188,17 +205,33 @@ class EngineConfig:
     # verify pass's position-0 sample (one token, plain-decode semantics).
     # 0 = off.
     spec_k: int = 0
+    # Adaptive per-stream speculation (spec_k > 0): every greedy stream
+    # carries an EWMA of its acceptance rate (accepted/k per verify
+    # round, decay spec_ewma_decay); the stream's next draft length is
+    # k = ceil(ewma * spec_k) in {1..spec_k} while the estimate holds
+    # >= spec_threshold, and the stream degrades to a plain decode row
+    # inside the same batch (k = 0: no proposals, it rides the verify's
+    # position-0 greedy choice) when the estimate falls below — low-
+    # acceptance traffic stops paying the (k+1)-wide verify tax.
+    # Degraded streams re-probe with k = 1 every spec_probe_every
+    # rounds so a stream whose output turns predictable again recovers.
+    # spec_threshold 0 disables degradation (always propose spec_k).
+    spec_threshold: float = 0.35
+    spec_probe_every: int = 8
+    spec_ewma_decay: float = 0.8
     # Overlapped decode scheduling (docs/performance.md "Overlapped
     # scheduling"): dispatch decode step N+1 — with step N's sampled
     # tokens fed back on-device — BEFORE reading step N's tokens to the
     # host, so the per-token host work (the read, emits, detokenize
     # downstream, EOS/window release, admission bookkeeping) runs while
     # the device computes. Steady-state inter-token latency becomes
-    # max(device_step, host_work) instead of their sum. None = auto: on
-    # for single-host role=both/decode engines without speculation; off
-    # under lockstep sync (the leader must emit host tokens before
-    # encoding the gang's event broadcast — gangs run flush-per-step)
-    # and with spec_k (a speculative round needs a settled batch).
+    # max(device_step, host_work) instead of their sum. Speculative
+    # rounds pipeline the same way: round N+1's proposal + verify
+    # dispatch from round N's device-resident output (the accept-mask
+    # advance), and the acceptance walk rides the deferred drain. None
+    # = auto: on for single-host role=both/decode engines; off under
+    # lockstep sync (the leader must emit host tokens before encoding
+    # the gang's event broadcast — gangs run flush-per-step).
     # False forces the synchronous scheduler — the escape hatch.
     overlap: Optional[bool] = None
     # SLO thresholds (observability/sketch.py): emits over budget
@@ -264,6 +297,31 @@ class _InFlightStep:
     tokens: Any  # device [B] int32 — this step's sampled tokens
     slots: List[tuple]  # [(slot, Request)] active at dispatch
     pos_next: np.ndarray  # host_positions after this step's increment
+
+
+@dataclass
+class _InFlightSpecStep:
+    """Bookkeeping for one dispatched speculative round whose host read
+    is deferred (the pipelined spec scheduler). The verify output stays
+    device-resident: round N+1's dispatch chains its inputs off
+    `choices`/`sampled` through the jitted accept-mask advance
+    (_build_spec_advance) — a device-side data dependency, never a host
+    round trip — and `_spec_drain` performs the round's ONE deferred
+    read for the host acceptance walk + emits. Same one-step
+    slot-release lag and identity-mask semantics as _InFlightStep.
+    host_positions is advanced only by the drain, so at drain time it
+    IS this round's base position (the emit snapshot)."""
+
+    choices: Any  # device [B, width] int32 — per-position greedy argmax
+    sampled: Any  # device [B] int32 — position-0 samples (sampling rows)
+    props: Any  # [B, width-1] int32 proposals (device in draft mode,
+    #   host numpy in lookup mode; width-1 may be 0 for a plain round)
+    positions: Any  # this round's input positions (device when chained)
+    k_eff: np.ndarray  # host [B] — per-stream draft length this round
+    tried: np.ndarray  # host [B] bool — planned a proposal (EWMA decays
+    #   on a lookup no-match even though k_eff was zeroed)
+    greedy: np.ndarray  # host [B] bool — acceptance-walk rows
+    slots: List[tuple]  # [(slot, Request)] active at dispatch
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -508,6 +566,14 @@ class Engine:
         if ec.spec_k < 0:
             raise ValueError(f"spec_k {ec.spec_k} invalid")
         self.spec = bool(ec.spec_k)
+        # Adaptive per-stream draft length (EngineConfig.spec_threshold):
+        # per-slot acceptance-rate EWMA (optimistic 1.0 at admission so
+        # new streams start at full spec_k) and the degraded-round
+        # counter that paces re-probes. Scheduler-thread state; the
+        # load_snapshot read races benignly (torn floats, never torn
+        # structure).
+        self._spec_ewma = np.ones((B,), np.float64)
+        self._spec_degraded = np.zeros((B,), np.int64)
         # draft model proposer, or prompt-lookup when no draft is given
         self.spec_draft = self.spec and draft is not None
         if self.spec_draft and not self.paged:
@@ -574,17 +640,20 @@ class Engine:
 
         # Overlapped decode scheduling (one-step-ahead dispatch; see
         # EngineConfig.overlap). Resolution order matters: lockstep
-        # gangs and speculative engines run flush-per-step regardless of
-        # the config — the broadcast/verify walk must observe a settled
-        # batch — and a prefill-role engine never decodes at all.
+        # gangs run flush-per-step regardless of the config — the event
+        # broadcast must observe a settled batch — and a prefill-role
+        # engine never decodes at all. Speculative engines DO overlap:
+        # the verify round chains on-device through the accept-mask
+        # advance, so the two levers multiply instead of cancelling.
         overlap = ec.overlap if ec.overlap is not None else True
         self.overlap = bool(
             overlap
             and ec.role != "prefill"
             and self.sync is None
-            and not self.spec
         )
-        self._pending: Optional[_InFlightStep] = None
+        # One in-flight step (plain _InFlightStep or _InFlightSpecStep),
+        # the pipeline's one-deep queue.
+        self._pending = None
         # Device-resident copy of the last dispatched step's sampled
         # tokens (the on-device feedback path) and the per-slot "the
         # host value is newer" mask: admission writes a first token the
@@ -635,9 +704,15 @@ class Engine:
             self._draft_chunk_fn = partial(
                 self._chunk_prefill_jit, self.model, self.draft_cfg
             )
-            self._propose_fn = self._build_propose()
+            self._propose_fn = self._build_propose(ec.spec_k)
+            # Width-1 rounds (every stream degraded/sampling) still run
+            # one draft step so the draft cache stays hole-free — the
+            # next wide round's proposal history needs every position
+            # below its start written (the proposals are discarded).
+            self._propose1_fn = self._build_propose(1)
         if self.spec:
             self._verify_fn = self._build_verify()
+            self._spec_advance = self._build_spec_advance()
         if not self.paged:
             self._prefill_fn = partial(self._prefill_jit, self.model, self.cfg)
             self._insert_fn = self._build_insert()
@@ -695,8 +770,8 @@ class Engine:
         )
         return logits[0, true_len - 1], slot_cache
 
-    def _build_propose(self):
-        model, cfg, k = self.model, self.draft_cfg, self.ec.spec_k
+    def _build_propose(self, k: int):
+        model, cfg = self.model, self.draft_cfg
 
         @partial(jax.jit, donate_argnums=(1,))
         def propose(params, cache, block_table, tokens, positions):
@@ -738,11 +813,21 @@ class Engine:
         cfg, ec, model, paged = self.cfg, self.ec, self.model, self.paged
 
         @partial(jax.jit, donate_argnums=(1,))
-        def verify(params, cache, block_table, block_tokens, positions0,
+        def verify(params, cache, block_table, tokens, props, positions0,
                    temps, top_ps, key_data, lora=None, adapter_ids=None):
-            """ONE target forward over [last, d1..dk] per slot ([B, k+1]).
-            Returns (greedy choices [B, k+1], position-0 samples [B] for
-            sampling slots, cache, key data)."""
+            """ONE target forward over [last, d1..dk] per slot
+            ([B, k+1]); `tokens` and `props` arrive separately (tokens
+            may be the previous round's device-resident output — the
+            concat is a device op, never a host round trip). A width-1
+            call (props [B, 0]) IS a plain decode step: one position,
+            choices[:, 0] the greedy token — which is what lets
+            degraded/sampling rounds share this code path with no
+            pipeline flush. Returns (greedy choices [B, k+1],
+            position-0 samples [B] for sampling slots, cache, key
+            data)."""
+            block_tokens = jnp.concatenate(
+                [tokens[:, None], props.astype(jnp.int32)], axis=1
+            )
             s = block_tokens.shape[1]
             positions = (
                 positions0[:, None]
@@ -764,6 +849,59 @@ class Engine:
             return choices, sampled, cache, kd
 
         return verify
+
+    def _build_spec_advance(self):
+        """The pipelined spec scheduler's on-device token feedback: from
+        an UNDRAINED verify round's device outputs, compute the next
+        round's (tokens, positions) without reading anything back — the
+        accept-mask analogue of _merge_tokens. Replays the host
+        acceptance walk as vectorized device ops: per greedy row the
+        longest matching proposal prefix, full acceptance advancing
+        k_eff with the last proposal as the seed (no bonus token — the
+        draft never wrote its kv), a mismatch advancing accepted+1 with
+        the verify's correction; sampling and degraded rows advance one
+        position. Freshly admitted rows take the host values admission
+        wrote (same `jnp.where(fresh, host, dev)` idiom as plain
+        overlap). Shapes are static per verify width, so each width
+        traces once."""
+        max_pos = self.ec.max_seq_len - 1
+
+        @jax.jit
+        def advance(choices, sampled, props, k_eff, greedy, pos0,
+                    host_tokens, host_positions, fresh):
+            kmax = props.shape[1]
+            if kmax > 0:
+                m = props == choices[:, :-1]
+                valid = (
+                    jnp.arange(kmax, dtype=jnp.int32)[None, :]
+                    < k_eff[:, None]
+                )
+                run = jnp.cumprod(
+                    (m & valid).astype(jnp.int32), axis=1
+                )
+                accepted = run.sum(axis=1).astype(jnp.int32)
+                full = (accepted == k_eff) & (k_eff > 0)
+                last_prop = jnp.take_along_axis(
+                    props, jnp.maximum(k_eff - 1, 0)[:, None], axis=1
+                )[:, 0]
+                corr = jnp.take_along_axis(
+                    choices, accepted[:, None], axis=1
+                )[:, 0]
+                adv_greedy = jnp.where(full, k_eff, accepted + 1)
+                tok_greedy = jnp.where(full, last_prop, corr)
+            else:
+                # Width-1 round: nothing proposed anywhere — every row
+                # is a plain decode row this round.
+                adv_greedy = jnp.ones_like(k_eff)
+                tok_greedy = choices[:, 0]
+            adv = jnp.where(greedy, adv_greedy, 1)
+            tok = jnp.where(greedy, tok_greedy, sampled).astype(jnp.int32)
+            nxt = jnp.minimum(pos0 + adv, max_pos).astype(jnp.int32)
+            tok = jnp.where(fresh, host_tokens, tok)
+            nxt = jnp.where(fresh, host_positions, nxt)
+            return tok, nxt
+
+        return advance
 
     def _build_slot_io(self):
         @jax.jit
@@ -1533,6 +1671,10 @@ class Engine:
         # The device token array predates this admission: the next
         # dispatch must take this slot's first token from the host.
         self._token_fresh[slot] = True
+        # Adaptive speculation starts optimistic for every new stream:
+        # the previous tenant's acceptance history must not leak.
+        self._spec_ewma[slot] = 1.0
+        self._spec_degraded[slot] = 0
         self.positions[slot] = true_len
         self.temps[slot] = req.temperature
         self.top_ps[slot] = req.top_p
@@ -1673,7 +1815,7 @@ class Engine:
             # gangs (overlap off) need the host copy below.
             self.key = key_out
         else:
-            self.key = np.asarray(key_out)  # sublint: allow[hostsync]: overlap-off (lockstep/spec) fallback only — the key rides host-side so every gang process feeds identical replicated inputs; the overlapped path above keeps it on device
+            self.key = np.asarray(key_out)  # sublint: allow[hostsync]: overlap-off (lockstep) fallback only — the key rides host-side so every gang process feeds identical replicated inputs; the overlapped path above keeps it on device
         self._dev_tokens = next_tokens
         self._token_fresh[:] = False
         # Clamp at the last cache row: active slots are released at the
@@ -1712,7 +1854,7 @@ class Engine:
                 pos_next=int(step.pos_next[slot]),
             )
         if not self.overlap:
-            # Synchronous path (gangs, spec fallback): the next dispatch
+            # Synchronous path (gangs, forced-sync): the next dispatch
             # must feed pure host-side numpy — in lockstep every process
             # replicates the identical input arrays, which is the whole
             # broadcast contract. Device token feedback is overlap-only.
@@ -1721,10 +1863,14 @@ class Engine:
 
     def _flush(self, reason: str) -> None:
         """Drain the in-flight step NOW. Required before anything that
-        must observe a settled batch: a speculative round (reason
-        "spec"), the lockstep event broadcast ("gang"), a disaggregated
-        KV handoff ("handoff"), engine stop/drain ("drain"), and
-        preemption or pool-pressure truncation ("preempt")."""
+        must observe a settled batch: the lockstep event broadcast
+        (reason "gang"), a disaggregated KV handoff ("handoff"), engine
+        stop/drain ("drain"), and preemption or pool-pressure
+        truncation ("preempt"). Speculative rounds no longer flush:
+        they chain on-device through the accept-mask advance, so the
+        historical "spec" reason is retired (steady-state spec traffic
+        holds pipeline_flushes_total{reason="spec"} at zero by
+        construction)."""
         pending, self._pending = self._pending, None
         if pending is None:
             return
@@ -1732,7 +1878,7 @@ class Engine:
             "substratus_serve_pipeline_flushes_total", {"reason": reason}
         )
         t_flush = time.perf_counter()
-        self._drain(pending)
+        self._drain_any(pending)
         # Timeline bubble accounting: a flush's drain is host work the
         # pipeline could NOT hide (the device sits settled through it).
         self._tl_flush_s += time.perf_counter() - t_flush
@@ -1742,17 +1888,32 @@ class Engine:
         self._dev_tokens = None
         self._token_fresh[:] = True
 
+    def _dispatch_any(self):
+        """The resolved dispatch half: a speculative round (propose +
+        multi-token verify) for spec engines, the plain decode step
+        otherwise. _decode_step/_step_overlapped/_flush route through
+        these two so both step kinds share one pipeline skeleton."""
+        return self._spec_dispatch() if self.spec else self._dispatch()
+
+    def _drain_any(self, step) -> None:
+        """The matching drain half, type-dispatched on the in-flight
+        bookkeeping (a flush may drain either kind)."""
+        if isinstance(step, _InFlightSpecStep):
+            self._spec_drain(step)
+        else:
+            self._drain(step)
+
     def _decode_step(self) -> None:
-        """One synchronous decode iteration: dispatch, model the device
-        step's latency, then drain immediately (the overlap-off path —
-        lockstep gangs and the speculative fallback). The simulated
+        """One synchronous iteration: dispatch, model the device step's
+        latency, then drain immediately (the overlap-off path —
+        lockstep gangs and the forced-sync escape hatch). The simulated
         device-step floor lands BEFORE the host read and the emits: on a
         real accelerator tokens only exist once the device step
         finishes, so a slot freed by an emit is admissible in the very
         next iteration with no artificial dead time. _loop's own floor
         check then sees dt >= floor and never double-sleeps."""
         t_step = time.perf_counter()
-        pending = self._dispatch()
+        pending = self._dispatch_any()
         self._tl_dispatch_s = time.perf_counter() - t_step
         if pending is None:
             return
@@ -1760,7 +1921,7 @@ class Engine:
         if self.ec.step_floor_s > dt_step:
             time.sleep(self.ec.step_floor_s - dt_step)
         t_drain = time.perf_counter()
-        self._drain(pending)
+        self._drain_any(pending)
         self._tl_drain_off_s = t_drain - self._tl_iter_t0
         self._tl_drain_s = time.perf_counter() - t_drain
 
@@ -1777,12 +1938,12 @@ class Engine:
         # dispatch's capacity handling may _flush("preempt") the
         # previous step itself, and draining it again here would emit
         # duplicate tokens.
-        launched = self._dispatch()
+        launched = self._dispatch_any()
         self._tl_dispatch_s = time.perf_counter() - t_step
         prev, self._pending = self._pending, launched
         if prev is not None:
             t_drain = time.perf_counter()
-            self._drain(prev)
+            self._drain_any(prev)
             self._tl_drain_off_s = t_drain - self._tl_iter_t0
             self._tl_drain_s = time.perf_counter() - t_drain
             if self._pending is not None:
@@ -1821,134 +1982,262 @@ class Engine:
                     return out
         return None
 
-    def _lookup_propose(self, k: int):
-        """Draft-free proposals for every active slot from its own token
-        history. Returns (proposals [max_batch, k] int32, matched mask
-        [max_batch] — placeholder rows must not count as proposals)."""
-        props = np.zeros((self.ec.max_batch, k), np.int32)
-        matched = np.zeros((self.ec.max_batch,), bool)
+    def _plan_spec_round(self):
+        """Host-side adaptive-k policy for the next speculative round
+        (EngineConfig.spec_threshold): per active slot, pick this
+        round's draft length from the stream's acceptance-rate EWMA.
+        Sampling slots never speculate (k draft steps + a wide verify
+        to emit ONE sampled token is strictly worse than plain decode);
+        greedy slots propose k = ceil(ewma * spec_k) while the estimate
+        holds, degrade to k = 0 below the threshold, and re-probe with
+        k = 1 every spec_probe_every degraded rounds. Returns host
+        (k_eff [B], tried [B], greedy [B]); the lookup scan may still
+        zero a planned k_eff when no n-gram matches."""
+        ec = self.ec
+        k_eff = np.zeros((ec.max_batch,), np.int64)
+        tried = np.zeros((ec.max_batch,), bool)
+        greedy = np.zeros((ec.max_batch,), bool)
         for slot in np.flatnonzero(self.active):
             slot = int(slot)
-            req = self.slot_req[slot]
-            keep = self.ec.max_seq_len - 1
-            ctx = (req.prompt_tokens[-keep:] or [0]) + self.slot_tokens[slot]
-            guess = self._prompt_lookup(ctx, k)
-            if guess is None:
-                props[slot] = ctx[-1]  # placeholder; verify still emits 1
+            if self.slot_req[slot].temperature != 0.0:
+                continue
+            greedy[slot] = True
+            ewma = float(self._spec_ewma[slot])
+            if ewma >= ec.spec_threshold:
+                k_eff[slot] = min(ec.spec_k, max(1, math.ceil(ewma * ec.spec_k)))
+                tried[slot] = True
+                self._spec_degraded[slot] = 0
             else:
-                props[slot] = guess
-                matched[slot] = True
-        return props, matched
+                self._spec_degraded[slot] += 1
+                if self._spec_degraded[slot] >= ec.spec_probe_every:
+                    # Probe: one cheap proposal so a stream whose output
+                    # turned predictable again can climb back out.
+                    self._spec_degraded[slot] = 0
+                    k_eff[slot] = 1
+                    tried[slot] = True
+        return k_eff, tried, greedy
 
-    def _spec_step(self) -> None:
-        """One speculative iteration for the whole batch: the proposer
-        (draft model, or prompt-lookup when draft-free) guesses spec_k
-        tokens, one target forward verifies k+1 positions. Greedy slots
-        emit the longest matching prefix (+ the target's correction on a
-        mismatch) — token-exact vs plain decode; sampling slots emit the
-        verify pass's position-0 sample. Cache staleness beyond the
-        accepted point is safe: causal masking never reads past the query
-        position, and the next round rewrites exactly those slots."""
-        # A speculative round proposes from slot_tokens and walks the
-        # verify output against settled per-slot state — it must never
-        # start with a step in flight. Spec engines resolve overlap off,
-        # so this is a no-op guard that keeps the invariant explicit
-        # (and keeps a future dynamic spec<->plain switchover honest).
-        self._flush("spec")
-        t_step = time.perf_counter()
-        k = self.ec.spec_k
-        # Speculation only pays off for greedy slots; an all-sampling batch
-        # would do k draft steps + a (k+1)-wide verify to emit one token
-        # per slot — strictly worse than one plain decode step.
-        if not any(
-            self.slot_req[int(s)].temperature == 0.0
-            for s in np.flatnonzero(self.active)
-        ):
-            self._decode_step()
-            return
+    def _spec_history(self, slot: int):
+        """Token history for the lookup scan, extended OPTIMISTICALLY
+        through the in-flight round — exact history lags one round
+        under the pipeline, and correctness is proposal-independent
+        (the verify rejects any mismatch), so the scan assumes the
+        in-flight proposals fully accept. In-flight k_eff=0 rows (the
+        degraded-probe case) have a genuinely unknown pending token;
+        the scan's own 1-token guess stands in for it, and None (no
+        guess either) skips speculation for this slot this round."""
+        req = self.slot_req[slot]
+        keep = self.ec.max_seq_len - 1
+        ctx = list(req.prompt_tokens[-keep:] or [0]) + self.slot_tokens[slot]
+        p = self._pending
+        if p is None or self._token_fresh[slot]:
+            # Settled batch, or a slot (re)admitted after the in-flight
+            # dispatch: host history is exact.
+            return ctx
+        ke = int(p.k_eff[slot])
+        if ke > 0:
+            ctx += [int(x) for x in p.props[slot, :ke]]
+        else:
+            guess = self._prompt_lookup(ctx, 1)
+            if guess is None:
+                return None
+            ctx.append(int(guess[0]))
+        return ctx
+
+    def _spec_dispatch(self) -> Optional[_InFlightSpecStep]:
+        """Device-only half of one speculative round: plan per-stream
+        draft lengths, run the (pure-numpy) lookup scans — under the
+        pipeline this host work executes during the PREVIOUS round's
+        device window, which is the point of the split — grow paged
+        capacity, chain the previous round's accepted tokens back
+        on-device through _build_spec_advance, launch the draft
+        proposal and the width-wide verify, and return the in-flight
+        bookkeeping WITHOUT reading anything back. The verify width is
+        max(k_eff)+1; a round where nothing proposes is a width-1
+        verify — exactly a plain decode step, one shared code path and
+        NO pipeline flush on the spec<->plain boundary. The host
+        acceptance walk belongs in _spec_drain(), one step later."""
+        k_eff, tried, greedy = self._plan_spec_round()
+        ec = self.ec
         lookup_props = None
-        lookup_matched = None
         if not self.spec_draft:
-            # Propose BEFORE paying for capacity/verify: a round with no
-            # n-gram match anywhere degrades to one plain decode step
-            # instead of a (k+1)-wide verify that accepts nothing.
-            lookup_props, lookup_matched = self._lookup_propose(k)
-            if not lookup_matched.any():
-                self._decode_step()
-                return
+            lookup_props = np.zeros((ec.max_batch, ec.spec_k), np.int32)
+            for slot in np.flatnonzero(k_eff > 0):
+                slot = int(slot)
+                ctx = self._spec_history(slot)
+                guess = (
+                    None if ctx is None
+                    else self._prompt_lookup(ctx, int(k_eff[slot]))
+                )
+                if guess is None:
+                    # No n-gram match (or an unknowable in-flight
+                    # token): a plain decode row this round. An actual
+                    # failed scan decays the EWMA; an unknowable
+                    # history does not — it says nothing about the
+                    # stream.
+                    tried[slot] = ctx is not None
+                    k_eff[slot] = 0
+                else:
+                    lookup_props[slot, : guess.size] = guess
+        km = k_eff.max()
+        width = int(km) + 1
         if self.paged:
+            # Grow every slot for this round's writes. The in-flight
+            # round may still advance a slot by up to its own
+            # max(1, k_eff) before this one lands, so that slack joins
+            # the bound; _pending is re-read per slot because
+            # _ensure_capacity may _flush("preempt") mid-loop (after
+            # which host_positions is settled and the slack is 0).
             for slot in np.flatnonzero(self.active):
+                slot = int(slot)
+                p = self._pending
+                slack = 0
+                if p is not None and not self._token_fresh[slot]:
+                    slack = max(1, int(p.k_eff[slot]))
                 self._ensure_capacity(
-                    int(slot), int(self.host_positions[slot]) + k
+                    slot,
+                    int(self.host_positions[slot]) + slack + width - 1,
                 )
             if not self.active.any():
-                return
+                return None
         bt = self.block_table if self.paged else None
-        if self.spec_draft:
-            proposals, self.draft_cache = self._propose_fn(
-                self.draft_params, self.draft_cache, bt,
-                self.tokens, self.positions,
-            )
-            props = np.asarray(proposals)  # sublint: allow[hostsync]: draft proposals must reach host for the accept/reject walk
+        p = self._pending
+        if p is None:
+            tok_in, pos_in = self.tokens, self.positions
         else:
-            props = lookup_props
-        block = np.concatenate([self.tokens[:, None], props], axis=1)
+            # Chain off the undrained round's device-resident verify
+            # output (JAX async dispatch makes this a device-side data
+            # dependency, never a host round trip); freshly (re)admitted
+            # slots merge their host-written first token/position.
+            tok_in, pos_in = self._spec_advance(
+                p.choices, p.sampled, p.props,
+                p.k_eff.astype(np.int32), p.greedy, p.positions,
+                self.tokens, self.positions, self._token_fresh,
+            )
+        if self.spec_draft:
+            if width > 1:
+                proposals, self.draft_cache = self._propose_fn(
+                    self.draft_params, self.draft_cache, bt,
+                    tok_in, pos_in,
+                )
+                props = proposals[:, : width - 1]
+            else:
+                # Width-1 round: one draft step keeps the draft cache
+                # hole-free for the next wide round (proposals
+                # discarded; see _propose1_fn in __init__).
+                warmed, self.draft_cache = self._propose1_fn(
+                    self.draft_params, self.draft_cache, bt,
+                    tok_in, pos_in,
+                )
+                props = warmed[:, :0]
+        else:
+            props = lookup_props[:, : width - 1]
         lora, adapter_ids = self._lora_inputs()
         choices, sampled, self.cache, key_out = self._verify_fn(
-            self.params, self.cache, bt, block,
-            self.positions, self.temps, self.top_ps, self.key,
+            self.params, self.cache, bt, tok_in, props,
+            pos_in, self.temps, self.top_ps, self.key,
             lora, adapter_ids,
         )
-        self.key = np.asarray(key_out)  # sublint: allow[hostsync]: RNG key rides host-side (lockstep replication contract)
-        self.stats["verify_passes"] += 1
+        if self.overlap:
+            # Key stays device-resident between rounds (reading it back
+            # would block on the verify just launched).
+            self.key = key_out
+        else:
+            self.key = np.asarray(key_out)  # sublint: allow[hostsync]: overlap-off fallback only — the key rides host-side so every lockstep process feeds identical replicated inputs; the overlapped path above keeps it on device
+        if width > 1:
+            # Width-1 rounds are plain decode steps, not verify passes —
+            # tokens_per_verify must keep meaning "emitted per wide
+            # verify forward".
+            self.stats["verify_passes"] += 1
+        self._token_fresh[:] = False
+        return _InFlightSpecStep(
+            choices=choices,
+            sampled=sampled,
+            props=props,
+            positions=pos_in,
+            k_eff=k_eff,
+            tried=tried,
+            greedy=greedy,
+            slots=[
+                (int(s), self.slot_req[int(s)])
+                for s in np.flatnonzero(self.active)
+            ],
+        )
 
-        # Same floor placement as _decode_step: simulated device latency
-        # precedes the host read + emits, so freed slots carry no
-        # artificial post-emit dead time.
-        dt_step = time.perf_counter() - t_step
-        if self.ec.step_floor_s > dt_step:
-            time.sleep(self.ec.step_floor_s - dt_step)
-        chs = np.asarray(choices)  # sublint: allow[hostsync]: THE per-spec-round host read — acceptance walk + emit need the verify output
-        smp = np.asarray(sampled)  # sublint: allow[hostsync]: same read as chs; one transfer per speculative round
-        next_tokens = self.tokens.copy()
-        for slot in np.flatnonzero(self.active):
-            slot = int(slot)
-            req = self.slot_req[slot]
-            if req.temperature != 0.0:
+    def _spec_drain(self, step: _InFlightSpecStep) -> None:
+        """Host half of one speculative round: THE deferred host read,
+        the per-slot acceptance walk, emits, EOS/budget/window release,
+        and the adaptive-k EWMA update. Greedy rows emit the longest
+        matching proposal prefix (+ the target's correction on a
+        mismatch; full acceptance emits k with no bonus token — the
+        draft never wrote the last proposal's kv, so it seeds the next
+        round and both caches stay hole-free) — token-exact vs plain
+        decode; sampling rows emit the verify's position-0 sample.
+        Cache staleness beyond the accepted point is safe: causal
+        masking never reads past the query position, and the next round
+        rewrites exactly those slots. host_positions is advanced only
+        here, so on entry it IS this round's base position; each emit
+        carries its own dispatch-time position snapshot (pos0 + i) so
+        the context-window release stays token-exact even though the
+        live arrays then jump by the whole accepted run."""
+        chs = np.asarray(step.choices)  # sublint: allow[hostsync]: THE deferred per-spec-round host read — the acceptance walk + emits land here, under the next round's device window
+        smp = np.asarray(step.sampled)  # sublint: allow[hostsync]: same deferred read as chs; one transfer per speculative round
+        props = np.asarray(step.props)  # sublint: allow[hostsync]: draft proposals reach host with the round's one deferred read (lookup proposals are already host numpy — a no-op there)
+        d = self.ec.spec_ewma_decay
+        for slot, req in step.slots:
+            if self.slot_req[slot] is not req:
+                continue  # EOS-lag mask: released or re-admitted slot
+            ke = int(step.k_eff[slot])
+            pos0 = int(self.host_positions[slot])
+            if not step.greedy[slot]:
                 emit_list = [int(smp[slot])]
             else:
                 accepted = 0
                 while (
-                    accepted < k
+                    accepted < ke
                     and props[slot, accepted] == chs[slot, accepted]
                 ):
                     accepted += 1
-                if lookup_matched is None or lookup_matched[slot]:
-                    # placeholder rows (no n-gram match) are not real
-                    # proposals — counting them would skew the
-                    # acceptance-rate statistic
-                    self.stats["spec_proposed"] += k
+                if ke > 0:
+                    self.stats["spec_proposed"] += ke
                     self.stats["spec_accepted"] += accepted
-                if accepted == k:
-                    # Full acceptance: no bonus token — the draft never
-                    # wrote the last proposal's kv, so it must seed the
-                    # next round (both caches stay hole-free).
-                    emit_list = [int(x) for x in props[slot]]
+                    METRICS.inc(
+                        "substratus_serve_spec_proposed_tokens_total",
+                        by=ke,
+                    )
+                    METRICS.inc(
+                        "substratus_serve_spec_accepted_tokens_total",
+                        by=accepted,
+                    )
+                    self._spec_ewma[slot] = (
+                        d * self._spec_ewma[slot]
+                        + (1.0 - d) * (accepted / ke)
+                    )
+                elif step.tried[slot]:
+                    # Planned a proposal but the lookup found nothing:
+                    # a zero-acceptance observation (placeholder rows
+                    # never skew the proposed/accepted counters).
+                    self._spec_ewma[slot] = d * self._spec_ewma[slot]
+                if ke > 0 and accepted == ke:
+                    emit_list = [int(x) for x in props[slot, :ke]]
                 else:
                     emit_list = [int(x) for x in props[slot, :accepted]]
                     emit_list.append(int(chs[slot, accepted]))
-            next_tokens[slot] = emit_list[-1]
-            for tok in emit_list:
-                self.host_positions[slot] += 1
-                self._emit(slot, tok)
-                if not self.active[slot]:
-                    break
-        self.tokens = next_tokens
-        # Same inactive-slot drift clamp as _decode_step.
-        self.host_positions = np.minimum(
-            self.host_positions, self.ec.max_seq_len - 1
-        )
-        self.positions = self.host_positions.astype(np.int32)
+            self.tokens[slot] = emit_list[-1]
+            for i, tok in enumerate(emit_list, start=1):
+                self._emit(slot, tok, pos_next=pos0 + i)
+                if self.slot_req[slot] is not req:
+                    break  # EOS/budget/window/cancel landed mid-run
+            npos = min(pos0 + len(emit_list), self.ec.max_seq_len - 1)
+            self.host_positions[slot] = npos
+            self.positions[slot] = npos
+        if not self.overlap:
+            # Synchronous path (gangs, forced-sync): the next dispatch
+            # must feed pure host-side numpy — every lockstep process
+            # replicates identical input arrays. Device chaining is
+            # overlap-only.
+            self._dev_tokens = None
+            self._token_fresh[:] = True
 
     def _release_slot(self, slot: int) -> None:
         self.active[slot] = False
@@ -2026,11 +2315,11 @@ class Engine:
 
     def _step(self) -> None:
         """One scheduler step on the resolved path: pipelined when
-        overlap is on, speculative or plain-synchronous otherwise."""
+        overlap is on, synchronous otherwise — _dispatch_any/_drain_any
+        route each iteration to the speculative or plain halves, so
+        spec engines pipeline exactly like plain ones."""
         if self.overlap:
             self._step_overlapped()
-        elif self.spec:
-            self._spec_step()
         else:
             self._decode_step()
 
@@ -2225,6 +2514,39 @@ class Engine:
             # up fleet-wide on every /loadz poll.
             "slo": self.slo.snapshot(),
         }
+        if self.spec:
+            # Speculation effectiveness for /loadz consumers (mirrors
+            # the substratus_serve_spec_*_tokens_total counters):
+            # lifetime acceptance plus each active stream's RESOLVED
+            # adaptive draft length — what the EWMA policy would plan
+            # next round, 0 for degraded/sampling rows. Torn reads are
+            # fine (same contract as the rest of this snapshot).
+            prop = self.stats["spec_proposed"]
+            acc = self.stats["spec_accepted"]
+            ks = []
+            for slot in np.flatnonzero(self.active):
+                slot = int(slot)
+                req = self.slot_req[slot]
+                ewma = float(self._spec_ewma[slot])
+                if (
+                    req is None
+                    or req.temperature != 0.0
+                    or ewma < self.ec.spec_threshold
+                ):
+                    ks.append(0)
+                else:
+                    ks.append(
+                        min(
+                            self.ec.spec_k,
+                            max(1, math.ceil(ewma * self.ec.spec_k)),
+                        )
+                    )
+            snap["spec"] = {
+                "proposed_tokens": prop,
+                "accepted_tokens": acc,
+                "acceptance": round(acc / prop, 4) if prop else None,
+                "adaptive_k": ks,
+            }
         src = self.source
         if src is not None and hasattr(src, "progress"):
             # Batch-generation progress (serve/batchgen.py): manifest

@@ -17,8 +17,10 @@ accepted tokens.
 This module is the standalone per-request API (llama-family) and the
 numerical reference for acceptance semantics. Production serving uses the
 ENGINE-INTEGRATED batched speculation: Engine(..., draft=(cfg, params)) with
-EngineConfig.spec_k > 0 (serve/engine.py::_spec_step) — same greedy
-acceptance rule, whole-batch proposals, paged KV on both models.
+EngineConfig.spec_k > 0 (serve/engine.py::_spec_dispatch/_spec_drain — the
+pipelined round split with on-device accept-mask chaining and per-stream
+adaptive draft length) — same greedy acceptance rule, whole-batch
+proposals, paged KV on both models.
 """
 from __future__ import annotations
 

@@ -12,8 +12,9 @@ The tier-1 gates here:
     growth computed one step ahead from host_positions stays correct
     across page boundaries; preemption forces a flush;
   * RESOLUTION — overlap is on by default for single-host role=both
-    engines and resolves OFF under lockstep sync, speculation, and the
-    prefill role (flush-per-step semantics preserved);
+    engines (speculative ones included, ISSUE 14) and resolves OFF
+    under lockstep sync and the prefill role (flush-per-step semantics
+    preserved);
   * LATENCY — `make overlap-bench` acceptance: steady-state inter-token
     mean <= 1.15x the simulated device-step floor with aggregate tok/s
     within 5% or better of synchronous, and idle-queue admission is
@@ -94,13 +95,14 @@ def counter_value(name, label_frag=""):
 
 
 def test_overlap_resolution(cfg, params):
-    """Default on for single-host role=both; off under lockstep sync,
-    speculation, prefill role, and the explicit escape hatch."""
+    """Default on for single-host role=both — INCLUDING speculative
+    engines (the pipelined spec scheduler chains verify rounds
+    on-device); off under lockstep sync, prefill role, and the explicit
+    escape hatch."""
     assert Engine(cfg, params, ec()).overlap is True
     assert Engine(cfg, params, ec(overlap=False)).overlap is False
-    assert Engine(cfg, params, ec(spec_k=2)).overlap is False
-    # Even an explicit True defers to the flush-per-step constraints.
-    assert Engine(cfg, params, ec(spec_k=2, overlap=True)).overlap is False
+    assert Engine(cfg, params, ec(spec_k=2)).overlap is True
+    assert Engine(cfg, params, ec(spec_k=2, overlap=False)).overlap is False
 
     class FakeSync:
         num_processes = 2

@@ -1,0 +1,478 @@
+"""Pipelined speculative decoding (serve/engine.py, ISSUE 14):
+overlap-composed verify rounds with adaptive per-stream draft length.
+
+The tier-1 gates here:
+
+  * PARITY — greedy output must be token-exact, spec+overlap vs plain
+    synchronous decode, across the dense and paged layouts, chunked
+    prefill, and multi-tenant adapters (the composition ISSUE 14 turns
+    on: neither lever may perturb the other's tokens);
+  * PIPELINE EDGES — cancellation and EOS landing between the spec
+    dispatch and its drain never leak tokens; preemption mid-spec
+    flushes and stays token-exact; the context-window release uses the
+    round's dispatch-time position snapshot (token-exact at the window);
+  * ADAPTIVE K — the per-stream acceptance EWMA degrades a
+    low-acceptance stream to a plain decode row and re-probes it back
+    when acceptance recovers;
+  * NO FLUSHES — steady-state spec traffic holds
+    pipeline_flushes_total{reason="spec"} at zero (the reason is
+    retired: rounds chain on-device instead of flushing).
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from substratus_tpu.models import llama
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.serve.engine import (
+    Engine,
+    EngineConfig,
+    Request,
+    _InFlightSpecStep,
+)
+
+
+def tiny_cfg():
+    return llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_cfg()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(cfg, jax.random.key(0))
+
+
+def ec(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("eos_token_id", 257)
+    return EngineConfig(**kw)
+
+
+def run_engine(cfg, params, econf, prompts, max_tokens=12, **eng_kw):
+    """Start an engine, run the prompts concurrently, return outputs."""
+    eng = Engine(cfg, params, econf, **eng_kw)
+    eng.start()
+    outs = [None] * len(prompts)
+
+    def one(i, p):
+        outs[i] = eng.generate(list(p), max_tokens=max_tokens,
+                               temperature=0.0)
+
+    threads = [
+        threading.Thread(target=one, args=(i, p))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.stop()
+    return outs
+
+
+def counter_value(name, label_frag=""):
+    total = 0.0
+    for line in METRICS.render().splitlines():
+        if line.startswith(name) and label_frag in line:
+            total += float(line.rsplit(" ", 1)[-1])
+    return total
+
+
+def _rep_prompts(n=4, length=16):
+    """Repetitive prompts (per-request distinct n-grams): the
+    prompt-lookup proposer's hitting case, so spec rounds genuinely go
+    wide under the pipeline."""
+    out = []
+    for i in range(n):
+        gram = [10 + 5 * i, 11 + 5 * i, 12 + 5 * i, 13 + 5 * i]
+        reps = -(-length // len(gram))
+        out.append((gram * reps)[:length])
+    return out
+
+
+# --- greedy parity: spec+overlap vs PLAIN decode (tier-1) ----------------
+
+
+@pytest.mark.parametrize("layout", ["paged", "dense"])
+def test_spec_overlap_parity_layouts(cfg, params, layout):
+    """Token-exact spec+overlap vs the plain synchronous scheduler,
+    both KV layouts, a full concurrent batch — acceptance walks, the
+    on-device accept-mask advance, and the one-step release lag must
+    all be invisible in the tokens."""
+    prompts = _rep_prompts()
+    spec = run_engine(
+        cfg, params, ec(kv_layout=layout, spec_k=3, overlap=True), prompts
+    )
+    plain = run_engine(
+        cfg, params, ec(kv_layout=layout, overlap=False), prompts
+    )
+    assert spec == plain, (spec, plain)
+    assert all(len(o) == 12 for o in spec)  # eos 257 never fires
+
+
+def test_spec_overlap_parity_chunked_prefill(cfg, params):
+    """Prompts spanning several prefill chunks admitted while spec
+    rounds are in flight: the fresh-slot host merge inside the
+    accept-mask advance must pick up the chunked first token."""
+    prompts = _rep_prompts(n=3, length=40)
+    kw = dict(max_prefill_len=16, max_seq_len=64)
+    spec = run_engine(
+        cfg, params, ec(spec_k=3, overlap=True, **kw), prompts,
+        max_tokens=8,
+    )
+    plain = run_engine(
+        cfg, params, ec(overlap=False, **kw), prompts, max_tokens=8
+    )
+    assert spec == plain and all(o for o in spec)
+
+
+def test_spec_overlap_parity_adapters(cfg, params):
+    """Mixed-tenant batch: the per-row adapter gather rides the verify
+    forward; spec+overlap must stay token-exact vs plain decode."""
+    from substratus_tpu.serve.adapters import AdapterStore
+    from substratus_tpu.train.lora import init_lora
+
+    def store():
+        st = AdapterStore(cfg, capacity=2, rank=4, dtype=jnp.float32)
+        for i, name in enumerate(("t-a", "t-b")):
+            tree = init_lora(cfg, jax.random.key(5 + i), rank=4,
+                             alpha=8.0, dtype=jnp.float32)
+            for j, k in enumerate(sorted(tree)):
+                tree[k]["b"] = np.asarray(
+                    jax.random.normal(
+                        jax.random.key(100 + 7 * i + j),
+                        tree[k]["b"].shape, jnp.float32,
+                    ) * 0.05
+                )
+            st.install(name, jax.tree.map(np.asarray, tree), scale=2.0)
+        return st
+
+    prompts = _rep_prompts()
+    adapters = [None, "t-a", "t-b", "t-a"]
+
+    def run(econf):
+        eng = Engine(cfg, params, econf, adapters=store())
+        eng.start()
+        outs = [None] * len(prompts)
+
+        def one(i):
+            outs[i] = eng.generate(
+                list(prompts[i]), max_tokens=10, temperature=0.0,
+                adapter=adapters[i],
+            )
+
+        ts = [threading.Thread(target=one, args=(i,))
+              for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        eng.stop()
+        return outs
+
+    assert run(ec(spec_k=3, overlap=True)) == run(ec(overlap=False))
+
+
+def test_spec_overlap_parity_at_window(cfg, params):
+    """Token-exact AT the context-window boundary: the window lands
+    mid-accepted-run, and the spec emit path must release on the
+    round's dispatch-time position snapshot (pos0 + i), not the live
+    host_positions the drain is about to bulk-advance — one token more
+    or fewer than plain decode fails this."""
+    prompts = _rep_prompts(n=3, length=8)
+    kw = dict(max_seq_len=24)
+    spec = run_engine(
+        cfg, params, ec(spec_k=3, overlap=True, **kw), prompts,
+        max_tokens=64,
+    )
+    plain = run_engine(
+        cfg, params, ec(overlap=False, **kw), prompts, max_tokens=64
+    )
+    assert spec == plain, (spec, plain)
+    # The window (not the budget) must have been what stopped them.
+    assert all(0 < len(o) < 64 for o in spec)
+
+
+def test_spec_overlap_parity_under_eos(cfg, params):
+    """An EOS produced inside an accepted run stops the stream exactly
+    where plain decode stops it: no token after the eos surfaces even
+    though the round verified (and the pipeline dispatched) past it."""
+    probe = run_engine(
+        cfg, params, ec(overlap=False), _rep_prompts(n=1), max_tokens=12
+    )[0]
+    # Stop on the first token value that has no earlier occurrence, so
+    # the truncation point is unambiguous.
+    idx = next(i for i in range(1, len(probe)) if probe[i] not in probe[:i])
+    eos = probe[idx]
+    prompts = _rep_prompts(n=1)
+
+    def run(econf):
+        eng = Engine(cfg, params, econf)
+        eng.start()
+        req = eng.submit(
+            Request(list(prompts[0]), max_tokens=12, temperature=0.0,
+                    eos_token_id=eos)
+        )
+        out = []
+        while True:
+            tok = req.out.get(timeout=120)
+            if tok is None:
+                break
+            out.append(tok)
+        eng.stop()
+        return out, req.finish_reason
+
+    spec = run(ec(spec_k=3, overlap=True))
+    plain = run(ec(overlap=False))
+    assert spec == plain
+    assert spec[1] == "stop" and spec[0] == probe[:idx], (spec, probe)
+
+
+# --- pipeline edge cases -------------------------------------------------
+
+
+def manual_engine(cfg, params, **kw):
+    """Engine whose scheduler loop is driven BY THE TEST (start() never
+    called): deterministic spec dispatch/drain interleaving."""
+    return Engine(cfg, params, ec(**kw))
+
+
+def admit_one(eng, prompt, **req_kw):
+    req = Request(list(prompt), temperature=0.0, **req_kw)
+    eng.queue.put(req)
+    assert eng._admit() == 1
+    return req
+
+
+def drain_sink(req):
+    out = []
+    while True:
+        try:
+            tok = req.out.get_nowait()
+        except Exception:
+            break
+        out.append(tok)
+    return out
+
+
+def test_cancel_between_spec_dispatch_and_drain(cfg, params):
+    """A cancellation landing while a spec round is in flight releases
+    the slot at the drain: none of the round's accepted tokens reach
+    the sink."""
+    eng = manual_engine(cfg, params, spec_k=3)
+    req = admit_one(eng, _rep_prompts(n=1)[0], max_tokens=16)
+    slot = eng.slot_req.index(req)
+    step = eng._spec_dispatch()
+    assert step is not None
+    req.cancelled = True  # lands mid-flight
+    eng._spec_drain(step)
+    assert not eng.active[slot]
+    toks = drain_sink(req)
+    # admission emit, then the terminal None — the whole in-flight
+    # accepted run was masked.
+    assert len(toks) == 2 and toks[-1] is None
+    assert req.finish_reason == "stop"
+
+
+def test_dead_stream_masked_at_spec_drain(cfg, params):
+    """A stream released while the round is in flight (engine-error
+    style) fails the request-identity check at the drain — no token
+    lands after its None."""
+    eng = manual_engine(cfg, params, spec_k=3)
+    req = admit_one(eng, _rep_prompts(n=1)[0], max_tokens=16)
+    slot = eng.slot_req.index(req)
+    step = eng._spec_dispatch()
+    req.finish_reason = "error"
+    req.out.put(None)
+    eng._release_slot(slot)
+    eng._spec_drain(step)
+    toks = drain_sink(req)
+    assert toks[-1] is None and toks.count(None) == 1
+    assert len(toks) == 2  # admission token + None, nothing after
+
+
+def test_preempt_flush_mid_spec_token_exact(cfg, params):
+    """Pool pressure while spec rounds pipeline: capacity growth must
+    flush the in-flight round before preempting (resume prompts need
+    every drained token) and outputs stay token-exact vs plain
+    decode."""
+    before = counter_value(
+        "substratus_serve_pipeline_flushes_total", 'reason="preempt"'
+    )
+    kw = dict(kv_layout="paged", page_size=4, kv_pool_tokens=48,
+              max_seq_len=48, prefix_cache=False)
+    prompts = _rep_prompts(n=3, length=4)
+    eng = Engine(cfg, params, ec(spec_k=2, overlap=True, **kw))
+    eng.start()
+    outs = [None] * len(prompts)
+
+    def one(i):
+        outs[i] = eng.generate(list(prompts[i]), max_tokens=16,
+                               temperature=0.0)
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stats = dict(eng.stats)
+    eng.stop()
+    plain = run_engine(cfg, params, ec(overlap=False, **kw), prompts,
+                       max_tokens=16)
+    assert outs == plain, (outs, plain)
+    assert stats["preemptions"] >= 1, stats
+    after = counter_value(
+        "substratus_serve_pipeline_flushes_total", 'reason="preempt"'
+    )
+    assert after > before, (before, after)
+
+
+# --- adaptive per-stream draft length ------------------------------------
+
+
+def _fab_step(eng, slot, req, ke, accepted):
+    """Fabricate a drained-shape spec round for one slot with a chosen
+    acceptance count — the deterministic way to steer the EWMA."""
+    B = eng.ec.max_batch
+    width = ke + 1
+    props = np.full((B, ke), 11, np.int32)
+    choices = np.full((B, width), 11, np.int32)
+    if accepted < ke:
+        choices[slot, accepted] = 12  # first mismatch
+    k_eff = np.zeros((B,), np.int64)
+    k_eff[slot] = ke
+    tried = np.zeros((B,), bool)
+    tried[slot] = True
+    greedy = np.zeros((B,), bool)
+    greedy[slot] = True
+    return _InFlightSpecStep(
+        choices=choices, sampled=np.zeros((B,), np.int32), props=props,
+        positions=eng.positions.copy(), k_eff=k_eff, tried=tried,
+        greedy=greedy, slots=[(slot, req)],
+    )
+
+
+def test_adaptive_k_degrades_and_recovers(cfg, params):
+    """Acceptance swings steer the per-stream draft length: sustained
+    rejection degrades the stream to a plain decode row (k = 0),
+    degraded streams re-probe on the configured cadence, and accepted
+    probes climb the stream back to speculating."""
+    eng = manual_engine(cfg, params, spec_k=4, spec_probe_every=3)
+    req = admit_one(eng, [256, 10, 20], max_tokens=10_000)
+    slot = eng.slot_req.index(req)
+
+    # Fresh stream: optimistic EWMA plans the full draft length.
+    k_eff, tried, greedy = eng._plan_spec_round()
+    assert greedy[slot] and tried[slot] and k_eff[slot] == 4
+
+    # Sustained rejection (accepted=0 rounds) decays the EWMA below the
+    # threshold: the stream degrades.
+    rounds = 0
+    while True:
+        k_eff, tried, _ = eng._plan_spec_round()
+        if k_eff[slot] == 0 and not tried[slot]:
+            break
+        eng._spec_drain(_fab_step(eng, slot, req, int(k_eff[slot]), 0))
+        rounds += 1
+        assert rounds < 20
+    assert float(eng._spec_ewma[slot]) < eng.ec.spec_threshold
+
+    # Degraded: plain rows until the probe cadence fires (k = 1).
+    k2, t2, _ = eng._plan_spec_round()
+    assert k2[slot] == 0 and not t2[slot]
+    k3, t3, _ = eng._plan_spec_round()
+    assert k3[slot] == 1 and t3[slot]  # the spec_probe_every=3 probe
+
+    # Fully accepted probes climb the EWMA back over the threshold.
+    rounds = 0
+    while float(eng._spec_ewma[slot]) < eng.ec.spec_threshold:
+        k_eff, tried, _ = eng._plan_spec_round()
+        if k_eff[slot] == 0:
+            continue  # ride the probe cadence
+        eng._spec_drain(_fab_step(eng, slot, req, int(k_eff[slot]), int(k_eff[slot])))
+        rounds += 1
+        assert rounds < 40
+    k_eff, tried, _ = eng._plan_spec_round()
+    assert k_eff[slot] >= 1 and tried[slot]  # recovered
+
+
+def test_adaptive_state_resets_on_admission(cfg, params):
+    """A slot's acceptance history must not leak to its next tenant:
+    admission resets the EWMA to optimistic."""
+    eng = manual_engine(cfg, params, spec_k=3)
+    req = admit_one(eng, [256, 10, 20], max_tokens=4)
+    slot = eng.slot_req.index(req)
+    eng._spec_ewma[slot] = 0.01  # scarred by the previous tenant
+    req.cancelled = True
+    step = eng._spec_dispatch()
+    eng._spec_drain(step)
+    assert not eng.active[slot]
+    req2 = admit_one(eng, [256, 30, 40], max_tokens=4)
+    assert eng.slot_req.index(req2) == slot
+    assert float(eng._spec_ewma[slot]) == 1.0
+
+
+# --- steady state: zero spec flushes -------------------------------------
+
+
+def test_steady_state_spec_flushes_zero(cfg, params):
+    """Real spec traffic under the pipeline: acceptance happens (the
+    rounds go wide), yet pipeline_flushes_total{reason="spec"} never
+    moves — rounds chain on-device instead of flushing. Also checks the
+    true spec counters and the load_snapshot mirror move together."""
+    flush_before = counter_value(
+        "substratus_serve_pipeline_flushes_total", 'reason="spec"'
+    )
+    prop_before = counter_value(
+        "substratus_serve_spec_proposed_tokens_total"
+    )
+    acc_before = counter_value(
+        "substratus_serve_spec_accepted_tokens_total"
+    )
+    prompts = _rep_prompts()
+    eng = Engine(cfg, params, ec(spec_k=3, overlap=True))
+    assert eng.overlap is True
+    eng.start()
+    outs = [None] * len(prompts)
+
+    def one(i):
+        outs[i] = eng.generate(list(prompts[i]), max_tokens=16,
+                               temperature=0.0)
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stats = dict(eng.stats)
+    snap = eng.load_snapshot()
+    eng.stop()
+    assert all(len(o) == 16 for o in outs)
+    assert stats["spec_accepted"] > 0, stats  # speculation genuinely ran
+    flush_after = counter_value(
+        "substratus_serve_pipeline_flushes_total", 'reason="spec"'
+    )
+    assert flush_after == flush_before, (flush_before, flush_after)
+    # Satellite: the true counters and /loadz mirror the stats dict.
+    assert (
+        counter_value("substratus_serve_spec_proposed_tokens_total")
+        - prop_before
+        == stats["spec_proposed"]
+    )
+    assert (
+        counter_value("substratus_serve_spec_accepted_tokens_total")
+        - acc_before
+        == stats["spec_accepted"]
+    )
+    assert snap["spec"]["proposed_tokens"] == stats["spec_proposed"]
+    assert snap["spec"]["accepted_tokens"] == stats["spec_accepted"]
+    assert snap["spec"]["acceptance"] is not None
+    assert isinstance(snap["spec"]["adaptive_k"], list)

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke autoscale-smoke gateway-bench adapter-bench disagg-bench overlap-bench spec-bench prefix-bench batchgen-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke journey-smoke autoscale-smoke gateway-bench adapter-bench disagg-bench overlap-bench spec-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -99,6 +99,15 @@ gateway-smoke:
 # substratus_fleet_* families on /metrics (tools/fleet_smoke.py).
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
+
+# Request-journey smoke (ISSUE 17 acceptance): gateway + 1 prefill + 1
+# decode worker in-process, ONE chat request through the gateway — the
+# response's x-trace-id must resolve on /debug/journeyz to a single
+# stitched journey whose waterfall shows all four hops (gateway edge,
+# prefill, KV handoff, decode) and `sub trace <id>` must render it
+# (tools/journey_smoke.py). JSON verdict on stdout.
+journey-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/journey_smoke.py
 
 # Closed-loop autoscaling smoke (ISSUE 12 acceptance): one in-process
 # replica behind the gateway, the real decision core closing the loop

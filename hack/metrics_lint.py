@@ -77,6 +77,21 @@ def main() -> int:
     fleet.signals()
     slo = SLOTracker()
     slo.observe("ttft", 5.0)  # over budget: burns
+    # Request-journey plane (observability/journey.py): record a short
+    # lifecycle so the per-type event counter renders, and attach an
+    # exemplar trace id to a breaching TTFT observation so the exemplar
+    # store exercises alongside the histogram sample it annotates.
+    from substratus_tpu.observability.journey import RequestJourney
+
+    j = RequestJourney(rid="lint-req", origin="lint")
+    for ev in ("submit", "admit", "prefill", "dispatch", "drain", "emit"):
+        j.record(ev)
+    j.breach("ttft", 5.0, 2.0)
+    j.record("end", reason="stop")
+    METRICS.inc("substratus_serve_slo_exemplars_total", {"slo": "ttft"})
+    METRICS.observe(
+        "substratus_serve_ttft_seconds", 5.0, exemplar=j.trace_id
+    )
     # Autoscale plane (controller/autoscale.py): an applied and a
     # frozen decision so the outcome counter and target gauge render.
     from substratus_tpu.controller.autoscale import (

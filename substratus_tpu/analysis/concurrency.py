@@ -63,6 +63,12 @@ DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     # the same unlocked-write scrutiny as the engine catches that on
     # the PR, not in production.
     "controller/autoscale.py",
+    # Request journeys (ISSUE 17): the event ring is written by the
+    # engine scheduler thread, stitched by the disagg reader thread,
+    # and read by /debug/requestz|slowz handlers — every shared write
+    # rides the per-journey _lock, and new entry points inherit the
+    # same unlocked-write scrutiny as the timeline ring.
+    "observability/journey.py",
 )
 
 _BLOCKING = {

@@ -1,11 +1,13 @@
 """protodrift-lint: producer/consumer agreement on hand-rolled wire
 formats.
 
-The serving stack has four hand-rolled protocols whose two ends live in
+The serving stack has five hand-rolled protocols whose two ends live in
 different modules (or different processes): the ``x-substratus-load``
 header (gateway/loadreport.py), the disagg KV-handoff frames
-(serve/disagg.py), the hello/PoolSpec negotiation, and the lockstep
-gang event broadcast (serve/multihost.py -> serve/engine.py). A key
+(serve/disagg.py), the hello/PoolSpec negotiation, the lockstep
+gang event broadcast (serve/multihost.py -> serve/engine.py), and the
+request-journey segment on the disagg done frame
+(observability/journey.py ``to_wire``/``from_wire``). A key
 written on one side and dropped on the other is silent data loss — the
 gateway quietly stops seeing transfer backlog, a decode worker ignores
 a sampling parameter — so this family extracts the emitted and parsed
@@ -83,6 +85,15 @@ DEFAULT_PROTOCOLS: Tuple[ProtoSpec, ...] = (
         kind="dict",
         producers=(("serve/multihost.py", "encode_events"),),
         consumers=(("serve/engine.py", "Engine._sync_iterate"),),
+    ),
+    # The request-journey segment shipped on the disagg ``done``
+    # back-channel frame (``"j"`` key — the frame-level "tpar"/"j" keys
+    # themselves ride the module-wide disagg-frames spec above).
+    ProtoSpec(
+        name="journey-segment",
+        kind="dict",
+        producers=(("observability/journey.py", "RequestJourney.to_wire"),),
+        consumers=(("observability/journey.py", "RequestJourney.from_wire"),),
     ),
 )
 
@@ -389,8 +400,8 @@ class ProtoDriftCheck(Check):
     description = (
         "producer/consumer key agreement on the hand-rolled wire "
         "formats (x-substratus-load header, disagg frames, PoolSpec "
-        "negotiation, gang event broadcast) and explicit-byte-order "
-        "struct/numpy pairing in the wire modules"
+        "negotiation, gang event broadcast, journey segments) and "
+        "explicit-byte-order struct/numpy pairing in the wire modules"
     )
 
     def __init__(

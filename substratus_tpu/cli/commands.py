@@ -21,6 +21,8 @@ import re
 import sys
 import tarfile
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from typing import Optional
@@ -575,6 +577,82 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`sub trace <id>` — the full request-journey waterfall for one trace
+    id (the `x-trace-id` response header) or request id: every lifecycle
+    event from gateway arrival through prefill, the KV handoff, decode,
+    and token emission, one row per event. Against a gateway `--url` it
+    queries /debug/journeyz (which joins the edge-side journey with every
+    replica's stitched engine journey); against a bare replica it falls
+    back to /debug/requestz?id=."""
+    base = (args.url or "http://localhost:8080").rstrip("/")
+    headers = {}
+    if getattr(args, "token", None):
+        headers["Authorization"] = f"Bearer {args.token}"
+    qid = urllib.parse.quote(args.id)
+    body = None
+    last_err = None
+    for path in ("/debug/journeyz", "/debug/requestz"):
+        req = urllib.request.Request(f"{base}{path}?id={qid}", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                body = json.loads(resp.read().decode())
+            break
+        except urllib.error.HTTPError as e:
+            # 404: replica without a gateway (no /debug/journeyz route) or
+            # an evicted/unknown journey — try the fallback endpoint.
+            last_err = f"{path} -> HTTP {e.code}"
+            if e.code not in (404,):
+                print(f"error: {base}{path} answered {e.code} {e.reason}",
+                      file=sys.stderr)
+                return 1
+        except (urllib.error.URLError, OSError) as e:
+            print(f"error: cannot reach {base}: {e}", file=sys.stderr)
+            return 1
+    if body is None or not isinstance(body, dict) or "journey" not in body:
+        print(f"no journey found for {args.id!r} ({last_err})",
+              file=sys.stderr)
+        return 1
+    journey = body.get("journey") or {}
+    events = body.get("waterfall") or []
+    print(f"trace {journey.get('trace_id', '?')}  "
+          f"request {journey.get('rid') or '-'}")
+    if not events:
+        print("no events recorded")
+        return 0
+    t0 = int(events[0].get("ts_us", 0))
+    rows = [("T+MS", "ORIGIN", "EVENT", "DETAIL")]
+    for ev in events:
+        data = ev.get("data") or {}
+        detail = (
+            " ".join(f"{k}={v}" for k, v in sorted(data.items()))
+            if isinstance(data, dict) else str(data)
+        )
+        rows.append(
+            (
+                f"{(int(ev.get('ts_us', t0)) - t0) / 1000.0:+.3f}",
+                str(ev.get("origin", "?")),
+                str(ev.get("type", "?")),
+                detail,
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths) + "  {}"
+    for r in rows:
+        print(fmt.format(*r))
+    breaches = journey.get("breaches") or []
+    if breaches:
+        print(
+            "SLO breaches: "
+            + ", ".join(
+                f"{b.get('slo', '?')}={b.get('seconds', 0):.4f}s"
+                f" (limit {b.get('threshold_s', 0):.4f}s)"
+                for b in breaches
+            )
+        )
+    return 0
+
+
 def cmd_version(args) -> int:
     from substratus_tpu import __version__
 
@@ -695,6 +773,18 @@ def register(sub) -> None:
     p.add_argument("--plain", action="store_true",
                    help="uncolored output")
     p.set_defaults(func=cmd_chat)
+
+    p = sub.add_parser(
+        "trace",
+        help="request-journey waterfall for one trace/request id",
+    )
+    p.add_argument("id", help="trace id (x-trace-id header) or request id")
+    p.add_argument(
+        "--url", default="http://localhost:8080",
+        help="gateway (or replica) endpoint",
+    )
+    p.add_argument("--token", help="bearer token for the /debug RBAC gate")
+    p.set_defaults(func=cmd_trace)
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(func=cmd_version)

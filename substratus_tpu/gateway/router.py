@@ -50,6 +50,12 @@ from substratus_tpu.gateway.limiter import (
 from substratus_tpu.gateway.loadreport import HEADER as LOAD_HEADER
 from substratus_tpu.gateway.loadreport import LoadReport
 from substratus_tpu.observability.httpstats import count_http_response
+from substratus_tpu.observability.journey import (
+    JourneyLog,
+    RequestJourney,
+    chrome_trace,
+    waterfall,
+)
 from substratus_tpu.observability.metrics import METRICS
 from substratus_tpu.observability.propagation import (
     format_traceparent,
@@ -182,6 +188,11 @@ class Gateway:
         )
         self.session: Optional[aiohttp.ClientSession] = None
         self._poll_task: Optional[asyncio.Task] = None
+        # Edge-side request journeys keyed by x-trace-id: arrival,
+        # shed/hedge/retry decisions, replica choice and why — the
+        # gateway's half of the waterfall `sub trace <id>` prints
+        # (joined with replica journeys via /debug/journeyz).
+        self.journeys = JourneyLog(cap=256)
         # Cold-start hint (scale-to-zero contract, docs/serving.md
         # "Autoscaling"): while a scale-up is in flight and no replica
         # is ready yet, sheds carry Retry-After derived from the plan's
@@ -307,7 +318,10 @@ class Gateway:
         )
 
     def _shed(self, reason: str, retry_after: float,
-              status: int = 503) -> web.Response:
+              status: int = 503, journey=None) -> web.Response:
+        if journey is not None:
+            journey.record("shed", reason=reason, status=status)
+            journey.record("end", reason="shed")
         METRICS.inc("substratus_gateway_sheds_total", {"reason": reason})
         cls = {429: web.HTTPTooManyRequests,
                503: web.HTTPServiceUnavailable,
@@ -387,6 +401,65 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         await _authorize_debug(request)
         return web.json_response(gw.fleet.snapshot())
 
+    @routes.get("/debug/journeyz")
+    async def journeyz(request: web.Request) -> web.Response:
+        """The full request waterfall for one trace id: the gateway's
+        edge journey joined with every replica's stitched journey
+        (fanned out to each replica's /debug/requestz?id=). Without
+        ?id= lists the edge ring's ids. `sub trace <id>` renders this
+        body as the edge→prefill→transfer→decode→emit timeline."""
+        await _authorize_debug(request)
+        wanted = request.query.get("id")
+        if not wanted:
+            return web.json_response({"journeys": gw.journeys.ids()})
+        edge = gw.journeys.find(wanted)
+        fwd_headers = {}
+        if "Authorization" in request.headers:
+            fwd_headers["Authorization"] = request.headers["Authorization"]
+        replica_journeys = []
+        timeout = aiohttp.ClientTimeout(
+            total=gw.cfg.connect_timeout + 2.0,
+            sock_connect=gw.cfg.connect_timeout,
+        )
+        for rep in list(gw.balancer.replicas.values()):
+            try:
+                async with gw.session.get(
+                    rep.url + "/debug/requestz", params={"id": wanted},
+                    headers=fwd_headers, timeout=timeout,
+                ) as resp:
+                    if resp.status != 200:
+                        continue
+                    body = await resp.json()
+            except _TRANSPORT_ERRORS:
+                continue
+            except (json.JSONDecodeError, aiohttp.ContentTypeError):
+                continue
+            j = body.get("journey")
+            if isinstance(j, dict):
+                j["replica"] = rep.url
+                replica_journeys.append(j)
+        if edge is None and not replica_journeys:
+            raise web.HTTPNotFound(text=f"no journey for id {wanted!r}")
+        merged = dict(edge) if edge is not None else {
+            "trace_id": wanted, "rid": None, "origin": "gateway",
+            "total": 0, "dropped": 0, "events": [], "marks": {},
+            "breaches": [], "segments": [],
+        }
+        # Flatten each replica journey AND its own stitched segments
+        # (the decode half of a disagg handoff) into one segment list,
+        # so the waterfall shows every hop on a shared time axis.
+        segments = list(merged.get("segments") or [])
+        for j in replica_journeys:
+            inner = j.pop("segments", None) or []
+            segments.append(j)
+            segments.extend(s for s in inner if isinstance(s, dict))
+        merged["segments"] = segments
+        return web.json_response({
+            "journey": merged,
+            "waterfall": waterfall(merged),
+            "chrome_trace": chrome_trace(merged),
+        })
+
     @routes.get("/v1/models")
     async def models(request: web.Request) -> web.Response:
         return await _route(request, b"", streaming=False)
@@ -412,20 +485,40 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         # is told to slow down even when its deadline is generous.
         ok, retry_after = gw.limiter.allow(api_key_of(request.headers))
         if not ok:
-            raise gw._shed("ratelimit", retry_after, status=429)
+            raise gw._shed(
+                "ratelimit", retry_after, status=429,
+                journey=_edge_journey_for_shed(request),
+            )
         if adapter:
             # Per-adapter quota (token bucket keyed by the routed
             # `model` field): one tenant's burst drains its own budget
             # instead of starving its co-tenants on the shared engine.
             ok, retry_after = gw.adapter_limiter.allow(adapter)
             if not ok:
-                raise gw._shed("adapter_quota", retry_after, status=429)
+                raise gw._shed(
+                    "adapter_quota", retry_after, status=429,
+                    journey=_edge_journey_for_shed(request),
+                )
         # Completions are admissions: in a disaggregated deployment
         # they must land on the prefill pool (serve/disagg.py) — the
         # decode tier only takes KV migrations. Monolithic replicas
         # report role "both" and match as before.
         return await _route(request, body, streaming=streaming,
                             adapter=adapter, role="prefill")
+
+    def _edge_journey_for_shed(
+        request: web.Request,
+    ) -> Optional[RequestJourney]:
+        """A journey for a PRE-route shed (rate limit / adapter quota):
+        only recorded when the caller sent a traceparent — without one
+        there is no id anyone could ever look the journey up by."""
+        remote = parse_traceparent(request.headers.get("traceparent"))
+        if remote is None:
+            return None
+        j = RequestJourney(trace_id=remote.trace_id, origin="gateway")
+        j.record("arrive", path=request.path)
+        gw.journeys.add(j)
+        return j
 
     async def _route(request: web.Request, body: bytes,
                      streaming: bool,
@@ -436,7 +529,10 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         )
         remaining = deadline_remaining(deadline)
         if remaining is not None and remaining <= 0:
-            raise gw._shed("deadline", 0.0, status=504)
+            raise gw._shed(
+                "deadline", 0.0, status=504,
+                journey=_edge_journey_for_shed(request),
+            )
 
         remote = parse_traceparent(request.headers.get("traceparent"))
         with tracer.span(
@@ -446,16 +542,30 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         ) as span:
             if adapter:
                 span.set_attribute("adapter", adapter)
+            # Edge journey keyed by this trace id (== the x-trace-id
+            # the client sees): the gateway half of the full waterfall.
+            journey = RequestJourney(
+                trace_id=span.trace_id, origin="gateway"
+            )
+            journey.record(
+                "arrive", path=request.path, stream=streaming,
+                adapter=adapter,
+            )
+            gw.journeys.add(journey)
             resp = await _attempts(
-                request, body, streaming, deadline, span, adapter, role
+                request, body, streaming, deadline, span, adapter, role,
+                journey,
             )
             span.set_attribute("http_status", resp.status)
+            if not journey.ended:
+                journey.record("end", status=resp.status)
             return resp
 
     async def _attempts(request: web.Request, body: bytes,
                         streaming: bool, deadline: Optional[float],
                         span, adapter: Optional[str] = None,
-                        role: Optional[str] = None
+                        role: Optional[str] = None,
+                        journey: Optional[RequestJourney] = None
                         ) -> web.StreamResponse:
         """The hedged-retry loop around single-replica attempts."""
         tried: tuple = ()
@@ -493,24 +603,40 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                 if stream_state["resp"] is not None:
                     return await give_up(None)
                 if gw.balancer.saturated():
-                    raise gw._shed("saturated", gw.cfg.shed_retry_after)
+                    raise gw._shed(
+                        "saturated", gw.cfg.shed_retry_after,
+                        journey=journey,
+                    )
                 # Zero ready replicas with a scale-up in flight: the
                 # honest answer is "come back when it lands", not a
                 # bare 503 (scale-to-zero cold start).
                 eta = gw.scale_eta_remaining()
                 if eta is not None:
-                    raise gw._shed("cold_start", eta)
-                raise gw._shed("no_replica", gw.cfg.backoff_base)
+                    raise gw._shed("cold_start", eta, journey=journey)
+                raise gw._shed(
+                    "no_replica", gw.cfg.backoff_base, journey=journey
+                )
             if attempt > 0:
                 METRICS.inc("substratus_gateway_hedges_total")
                 span.set_attribute("hedged", True)
+                if journey is not None:
+                    journey.record("hedge", attempt=attempt + 1)
             span.set_attribute("replica", rep.url)
             span.set_attribute("attempts", attempt + 1)
+            if journey is not None:
+                # The routing decision AND why: which replica, its
+                # current in-flight depth, adapter/role affinity asked.
+                journey.record(
+                    "replica", url=rep.url, attempt=attempt + 1,
+                    inflight=rep.inflight, adapter=adapter, role=role,
+                )
             remaining = deadline_remaining(deadline)
             if remaining is not None and remaining <= 0:
                 if stream_state["resp"] is not None:
                     return await give_up(None)
-                raise gw._shed("deadline", 0.0, status=504)
+                raise gw._shed(
+                    "deadline", 0.0, status=504, journey=journey
+                )
 
             gw.balancer.acquire(rep)
             gw._set_inflight(rep)
@@ -528,6 +654,10 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                 gw._fail(rep)
                 tried = tried + (rep.url,)
                 log.info("attempt on %s failed: %r", rep.url, e)
+                if journey is not None:
+                    journey.record(
+                        "retry", replica=rep.url, cause="transport"
+                    )
                 continue  # hedge: nothing reached the client yet
             finally:
                 gw.balancer.release(rep)
@@ -535,6 +665,10 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             if isinstance(result, _ReplicaShed):
                 tried = tried + (rep.url,)
                 shed_response = result.response
+                if journey is not None:
+                    journey.record(
+                        "retry", replica=rep.url, cause="replica_shed"
+                    )
                 # Sustained shed rate per replica (gateway/fleet.py):
                 # overload evidence the autoscaler reads once queue
                 # bounds keep queue-depth EWMAs flat.

@@ -50,6 +50,12 @@ from substratus_tpu.observability.events import (  # noqa: F401
     EventRecorder,
 )
 from substratus_tpu.observability.health import serve_health  # noqa: F401
+from substratus_tpu.observability.journey import (  # noqa: F401
+    EVENT_TYPES,
+    JourneyLog,
+    RequestJourney,
+    SlowRing,
+)
 from substratus_tpu.observability.sketch import (  # noqa: F401
     Sketch,
     SLOTracker,
@@ -62,9 +68,13 @@ from substratus_tpu.observability.timeline import (  # noqa: F401
 __all__ = [
     "BUBBLE_CAUSES",
     "EVENTS",
+    "EVENT_TYPES",
     "EventRecorder",
+    "JourneyLog",
     "LATENCY_BUCKETS",
     "METRICS",
+    "RequestJourney",
+    "SlowRing",
     "RATIO_BUCKETS",
     "THROUGHPUT_BUCKETS",
     "Histogram",

@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 Labels = Union[str, Mapping[str, object], None]
@@ -96,14 +97,19 @@ def _labelstr(labels: Labels) -> str:
 
 
 class _Hist:
-    """One histogram series: cumulative bucket counts + sum + count."""
+    """One histogram series: cumulative bucket counts + sum + count,
+    plus an optional per-bucket exemplar (last trace id observed into
+    the bucket WITH an exemplar — OpenMetrics semantics; the 0.0.4 text
+    exposition cannot carry them, so they surface via the
+    ``exemplars()`` read API / debug JSON instead)."""
 
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # per-bucket (non-cumulative) counts
         self.sum = 0.0
         self.count = 0
+        self.exemplars: Optional[Dict[int, dict]] = None  # bucket idx -> ex
 
 
 class Metrics:
@@ -179,9 +185,13 @@ class Metrics:
     def observe(
         self, name: str, value: float, labels: Labels = "",
         buckets: Optional[Sequence[float]] = None,
+        exemplar: Optional[str] = None,
     ) -> None:
         """Record `value` into the `name` histogram (declared on first use;
-        `buckets` applies only then)."""
+        `buckets` applies only then). `exemplar` attaches a trace id to
+        the bucket this observation lands in (OpenMetrics-style; last
+        writer wins per bucket) — dashboards jump from a p99 bucket to
+        the offending request's journey through it."""
         key = (name, _labelstr(labels))
         with self._lock:
             self._family(name, "histogram")
@@ -203,6 +213,14 @@ class Metrics:
             h.counts[i] += 1
             h.sum += v
             h.count += 1
+            if exemplar is not None:
+                if h.exemplars is None:
+                    h.exemplars = {}
+                h.exemplars[i] = {
+                    "trace_id": str(exemplar),
+                    "value": v,
+                    "ts": time.time(),
+                }
 
     # -- reads -------------------------------------------------------------
 
@@ -240,6 +258,27 @@ class Metrics:
                     buckets.append((bound, cum))
                 out[ls] = {"buckets": buckets, "sum": h.sum, "count": h.count}
             return out
+
+    def exemplars(self, name: str, labels: Labels = "") -> Dict[str, dict]:
+        """Exemplars attached to one histogram series, keyed by the
+        bucket's `le` rendering:
+
+            {"0.25": {"trace_id": ..., "value": ..., "ts": ...}, ...}
+
+        Empty when the series is unknown or nothing carried an
+        exemplar. The text exposition stays format 0.0.4 (no `# {...}`
+        suffixes); this read API + the debug planes are the carrier."""
+        key = (name, _labelstr(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            bs = self._buckets.get(name)
+            if h is None or bs is None or not h.exemplars:
+                return {}
+            bounds = tuple(bs) + (math.inf,)
+            return {
+                _fmt_le(bounds[i]): dict(ex)
+                for i, ex in h.exemplars.items()
+            }
 
     def remove(self, name: str, labels: Labels = "") -> None:
         """Drop ONE series (the family's declaration stays). For

@@ -169,15 +169,20 @@ class SLOTracker:
         self._lock = threading.Lock()
         self._burn: Dict[str, int] = {name: 0 for name in self.thresholds}
 
-    def observe(self, slo: str, seconds: float) -> None:
+    def observe(self, slo: str, seconds: float) -> bool:
+        """Record one observation; returns True when it breached the
+        SLO threshold (the engine's journey layer captures the breaching
+        request as a /debug/slowz exemplar on a True return)."""
         sk = self.sketches.get(slo)
         if sk is None:
-            return  # unknown SLO name: a typo must not crash the emit path
+            return False  # unknown SLO name must not crash the emit path
         sk.observe(seconds)
         if seconds > self.thresholds[slo]:
             with self._lock:
                 self._burn[slo] += 1
             METRICS.inc("substratus_slo_burn_total", {"slo": slo})
+            return True
+        return False
 
     def burn(self, slo: str) -> int:
         with self._lock:

@@ -59,12 +59,19 @@ import socket
 import struct
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from substratus_tpu.observability.journey import RequestJourney
 from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.observability.propagation import (
+    format_traceparent,
+    parse_traceparent,
+)
+from substratus_tpu.observability.tracing import SpanContext
 
 log = logging.getLogger("substratus.serve.disagg")
 
@@ -395,6 +402,17 @@ class HandoffManager:
         """Try every peer once; on total failure the request fails
         loudly (the no-worker-left case must not hang the client)."""
         manifest, payload = encode_pages(pages)
+        # W3C trace context rides the handoff so the decode tier parents
+        # its spans AND its journey segment under the same trace id —
+        # without it every decode-side span is an orphan root. "tpar",
+        # not "tp": this header already carries top_p under "tp".
+        tpar = None
+        if req.trace_ctx is not None:
+            tpar = format_traceparent(req.trace_ctx)
+        elif getattr(req, "journey", None) is not None:
+            tpar = format_traceparent(
+                SpanContext(req.journey.trace_id, uuid.uuid4().hex[:16])
+            )
         header = {
             "t": "kv",
             "rid": req.id,
@@ -406,6 +424,7 @@ class HandoffManager:
             "tp": req.top_p,
             "eos": req.eos_token_id,
             "ad": req.adapter,
+            "tpar": tpar,
             "arrays": manifest,
         }
         peers = self._resolved_peers()
@@ -506,7 +525,8 @@ class HandoffManager:
                     self._on_token(ch, str(header["rid"]), int(header["k"]))
                 elif kind == "done":
                     self._on_done(
-                        str(header["rid"]), str(header.get("fr", "stop"))
+                        str(header["rid"]), str(header.get("fr", "stop")),
+                        header.get("j"),
                     )
         except (OSError, ValueError) as e:
             if not ch.dead and not self._stop.is_set():
@@ -538,14 +558,28 @@ class HandoffManager:
             except OSError:
                 pass  # the reader will notice the dead channel
 
-    def _on_done(self, rid: str, finish_reason: str) -> None:
+    def _on_done(self, rid: str, finish_reason: str,
+                 segment: Optional[dict] = None) -> None:
         with self._lock:
             flight = self._flights.pop(rid, None)
         if flight is None:
             return
         flight.done = True
-        flight.req.finish_reason = finish_reason
-        flight.req.out.put(None)
+        req = flight.req
+        req.finish_reason = finish_reason
+        # Stitch the decode tier's journey segment (the done frame's "j"
+        # field) into the prefill-side journey BEFORE the terminal marker:
+        # the merged journey — one trace id spanning both processes — is
+        # what journey_log/slowz snapshot.
+        j = getattr(req, "journey", None)
+        if j is not None and segment:
+            j.stitch(segment)
+        eng = self.engine
+        if eng is not None:
+            eng._journey_end(req, finish_reason)
+        elif j is not None and not j.ended:
+            j.record("end", reason=finish_reason)
+        req.out.put(None)
 
     # -- failure handling --------------------------------------------------
 
@@ -575,6 +609,12 @@ class HandoffManager:
         req.max_tokens -= len(flight.emitted)
         if req.max_tokens <= 0 or req.cancelled:
             req.finish_reason = "length" if not req.cancelled else "stop"
+            eng = self.engine
+            j = getattr(req, "journey", None)
+            if eng is not None:
+                eng._journey_end(req, req.finish_reason, cause="requeue")
+            elif j is not None and not j.ended:
+                j.record("end", reason=req.finish_reason, cause="requeue")
             req.out.put(None)
             return
         if self.engine is None:
@@ -583,12 +623,32 @@ class HandoffManager:
         METRICS.inc(
             "substratus_serve_kv_transfers_total", {"outcome": "requeued"}
         )
-        log.info("requeueing request %s after decode-worker loss", req.id)
+        # The SAME Request object re-enters admission: trace_ctx and the
+        # journey ride along, so the re-prefill is visibly the same trace
+        # in tracez/journeys — never a fresh root (resubmit stamps the
+        # "requeue" journey event).
+        log.info(
+            "requeueing request %s after decode-worker loss (trace_id=%s)",
+            req.id,
+            getattr(req, "journey", None) and req.journey.trace_id,
+        )
         self.engine.resubmit(req)
 
-    @staticmethod
-    def _fail(req) -> None:
+    def _fail(self, req) -> None:
+        """Terminal error marker. Carries the original trace id into the
+        log line and the journey ring so a dead-decode-worker failure is
+        attributable to the request's trace, not an anonymous root."""
         req.finish_reason = "error"
+        j = getattr(req, "journey", None)
+        log.error(
+            "handoff failed for request %s (trace_id=%s)",
+            req.id, j.trace_id if j is not None else None,
+        )
+        eng = self.engine
+        if eng is not None:
+            eng._journey_end(req, "error", cause="handoff")
+        elif j is not None and not j.ended:
+            j.record("end", reason="error", cause="handoff")
         req.out.put(None)
 
 
@@ -626,7 +686,22 @@ class _RemoteSink:
         try:
             if item is None:
                 fr = self.req.finish_reason if self.req is not None else "stop"
-                self.channel.send({"t": "done", "rid": self.rid, "fr": fr})
+                # Ship the decode-side journey segment back with the
+                # terminal frame — the prefill side stitches it into ONE
+                # merged journey spanning both processes. The engine's
+                # _journey_end ran before this put(None), so the segment
+                # carries its own "end" event.
+                j = (
+                    getattr(self.req, "journey", None)
+                    if self.req is not None else None
+                )
+                if j is not None:
+                    self.channel.send(
+                        {"t": "done", "rid": self.rid, "fr": fr,
+                         "j": j.to_wire()}
+                    )
+                else:
+                    self.channel.send({"t": "done", "rid": self.rid, "fr": fr})
             else:
                 self.channel.send(
                     {"t": "tok", "rid": self.rid, "k": int(item)}
@@ -764,6 +839,22 @@ class HandoffServer:
         pages = decode_pages(header["arrays"], payload)
         rid = str(header["rid"])
         sink = _RemoteSink(ch, rid)
+        # Parent this tier's spans and journey under the prefill side's
+        # trace context ("tpar" header): the decode half of the request
+        # keeps the SAME trace id, so the prefill side can stitch the
+        # returned segment into one merged journey.
+        tctx = parse_traceparent(header.get("tpar") or "")
+        journey = RequestJourney(
+            trace_id=(tctx.trace_id if tctx is not None else None),
+            rid=rid,
+            origin="decode",
+            cap=self.engine.ec.journey_events,
+        )
+        journey.record(
+            "kv_recv",
+            bytes=len(payload),
+            prompt_tokens=len(header["p"]),
+        )
         req = self._Request(
             prompt_tokens=[int(x) for x in header["p"]],
             max_tokens=int(header["m"]),
@@ -775,6 +866,8 @@ class HandoffServer:
             adapter=header.get("ad"),
             id=rid,
             out=sink,
+            trace_ctx=tctx,
+            journey=journey,
         )
         sink.req = req
         reqs[rid] = req

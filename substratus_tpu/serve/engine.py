@@ -35,6 +35,11 @@ import numpy as np
 
 from substratus_tpu.models import llama
 from substratus_tpu.models.llama import LlamaConfig, Params
+from substratus_tpu.observability.journey import (
+    JourneyLog,
+    RequestJourney,
+    SlowRing,
+)
 from substratus_tpu.observability.metrics import METRICS, RATIO_BUCKETS
 from substratus_tpu.observability.sketch import SLOTracker
 from substratus_tpu.observability.timeline import StepTimeline
@@ -240,6 +245,13 @@ class EngineConfig:
     # aggregator (gateway/fleet.py) rolls them up fleet-wide.
     slo_ttft_s: float = 2.0
     slo_inter_token_s: float = 0.25
+    # Request-journey forensics (observability/journey.py): per-request
+    # lifecycle event ring size and the /debug/slowz exemplar ring of
+    # SLO-breaching journeys. Recording is pure host work on the
+    # scheduler thread (dispatch events stamp at drain), so it stays on
+    # in production.
+    journey_events: int = 256
+    slow_journeys: int = 32
 
 
 @dataclass
@@ -280,6 +292,10 @@ class Request:
     submit_ts: float = 0.0
     last_emit_ts: float = 0.0
     trace_ctx: Optional[SpanContext] = None
+    # Lifecycle event timeline (observability/journey.py): created at
+    # submit (or KV-install on a decode-role engine) under the request's
+    # trace id; the engine copies it into its JourneyLog at terminal.
+    journey: Optional[RequestJourney] = None
 
 
 @dataclass
@@ -297,6 +313,7 @@ class _InFlightStep:
     tokens: Any  # device [B] int32 — this step's sampled tokens
     slots: List[tuple]  # [(slot, Request)] active at dispatch
     pos_next: np.ndarray  # host_positions after this step's increment
+    t_dispatch: float = 0.0  # host perf_counter at launch (journey drain latency)
 
 
 @dataclass
@@ -322,6 +339,7 @@ class _InFlightSpecStep:
     #   on a lookup no-match even though k_eff was zeroed)
     greedy: np.ndarray  # host [B] bool — acceptance-walk rows
     slots: List[tuple]  # [(slot, Request)] active at dispatch
+    t_dispatch: float = 0.0  # host perf_counter at launch (journey drain latency)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -684,6 +702,13 @@ class Engine:
             "ttft": ec.slo_ttft_s,
             "inter_token": ec.slo_inter_token_s,
         })
+        # Request-journey retention (observability/journey.py): completed
+        # journeys for /debug/requestz?id= and the SLO-breach exemplar
+        # ring for /debug/slowz. Both lock-guarded: the scheduler (and,
+        # for prefill engines, the handoff manager's reader thread) add
+        # while HTTP handler threads search.
+        self.journey_log = JourneyLog()
+        self.slow = SlowRing(ec.slow_journeys)
         # Per-replica monotonic load-report sequence (gateway dedupe of
         # hedged/retried report deliveries): itertools.count is
         # atomic under the GIL, and load_snapshot() is called from
@@ -1073,6 +1098,18 @@ class Engine:
         req.submit_ts = time.perf_counter()
         if req.trace_ctx is None:
             req.trace_ctx = tracer.current_context()
+        if req.journey is None:
+            req.journey = RequestJourney(
+                trace_id=(
+                    req.trace_ctx.trace_id if req.trace_ctx else None
+                ),
+                rid=req.id or None, origin=self.ec.role,
+                cap=self.ec.journey_events,
+            )
+        req.journey.record(
+            "submit", queue=self.queue.qsize(),
+            prompt_tokens=len(req.prompt_tokens),
+        )
         self.queue.put(req)
         self._wake.set()
         if self.error is not None:
@@ -1095,6 +1132,8 @@ class Engine:
             req.finish_reason = "error"
             req.out.put(None)
             return
+        if req.journey is not None:
+            req.journey.record("requeue", queue=self.queue.qsize())
         self.queue.put(req)
         self._wake.set()
         if self.error is not None:  # same submit() race: never strand it
@@ -1305,6 +1344,8 @@ class Engine:
             if verdict == "wait":
                 # Transient: every adapter slot is pinned by an active
                 # request. Hold at the front; decoding slots will unpin.
+                if req.journey is not None:
+                    req.journey.record_once("adapter_wait")
                 self._admitting = None
                 self._resume.insert(0, req)
                 break
@@ -1316,6 +1357,12 @@ class Engine:
                     "substratus_serve_queue_wait_seconds",
                     time.perf_counter() - req.submit_ts,
                 )
+            if req.journey is not None:
+                wait_us = (
+                    int((time.perf_counter() - req.submit_ts) * 1e6)
+                    if req.submit_ts and not req.last_emit_ts else 0
+                )
+                req.journey.record("admit", slot=slot, wait_us=wait_us)
             t_prefill = time.perf_counter()
             with tracer.span(
                 "engine.prefill", parent=req.trace_ctx,
@@ -1336,6 +1383,8 @@ class Engine:
                 # Pool dry even after eviction: hold the request at the
                 # front of the line; decoding slots will free pages. The
                 # adapter pin drops too — re-admission re-acquires.
+                if req.journey is not None:
+                    req.journey.record_once("pool_wait")
                 self._release_adapter_pin(req)
                 self._resume.insert(0, req)
                 # Timeline: this iteration's admission time was spent
@@ -1422,6 +1471,10 @@ class Engine:
         self.positions[slot] = true_len
         self.temps[slot] = req.temperature
         self.top_ps[slot] = req.top_p
+        if req.journey is not None:
+            req.journey.record(
+                "install", slot=slot, pages=n, tokens=true_len
+            )
         # The first token was sampled on the prefill engine but never
         # delivered — this emit is its delivery (the whole stream flows
         # from the decode tier).
@@ -1452,6 +1505,8 @@ class Engine:
         self.block_table[slot] = 0
         self._release_adapter_pin(req)
         self.stats["handoffs"] += 1
+        if req.journey is not None:
+            req.journey.record("ship", tokens=true_len, pages=n)
         self.handoff.ship(req, host, true_len, first_id)
 
     def _acquire_adapter(self, req: Request) -> str:
@@ -1484,6 +1539,7 @@ class Engine:
                 req.adapter, req.id, e,
             )
             req.finish_reason = "error"
+            self._journey_end(req, "error", cause="adapter")
             req.out.put(None)
             if req.sync_id is not None:
                 self._sync_reqs.pop(req.sync_id, None)
@@ -1524,6 +1580,11 @@ class Engine:
             last_logits = self._chunked_prefill(prompt, slot, lora, ids1)
         self.stats["prefill_tokens"] += true_len
         METRICS.inc("substratus_serve_prefill_tokens_total", by=true_len)
+        if req.journey is not None:
+            req.journey.record(
+                "prefill", tokens=true_len,
+                chunks=max(1, -(-true_len // self.ec.max_prefill_len)),
+            )
         self._finalize_admit(req, slot, last_logits, true_len)
         return True
 
@@ -1591,6 +1652,15 @@ class Engine:
         if reuse:
             METRICS.inc(
                 "substratus_serve_prefix_hit_tokens_total", by=reuse
+            )
+        if req.journey is not None:
+            if reuse:
+                req.journey.record("prefix_hit", tokens=reuse)
+            req.journey.record(
+                "prefill", tokens=true_len - reuse,
+                chunks=max(
+                    1, -(-(true_len - reuse) // self.ec.max_prefill_len)
+                ),
             )
 
         if self.spec_draft:
@@ -1719,6 +1789,8 @@ class Engine:
         gen = self.slot_tokens[victim]
         req.prompt_tokens = list(req.prompt_tokens) + gen
         req.max_tokens -= len(gen)
+        if req.journey is not None:
+            req.journey.record("preempt", generated=len(gen))
         self._release_slot(victim)
         self._resume.insert(0, req)
         self.stats["preemptions"] += 1
@@ -1756,6 +1828,7 @@ class Engine:
                 if victim is None:
                     req = self.slot_req[slot]
                     req.finish_reason = "length"
+                    self._journey_end(req, "length", cause="pool")
                     req.out.put(None)
                     if req.sync_id is not None:
                         self._sync_reqs.pop(req.sync_id, None)
@@ -1834,6 +1907,7 @@ class Engine:
                 for s in np.flatnonzero(self.active)
             ],
             pos_next=self.host_positions.copy(),
+            t_dispatch=time.perf_counter(),
         )
 
     def _drain(self, step: _InFlightStep) -> None:
@@ -1845,9 +1919,17 @@ class Engine:
         its in-flight token — the pipeline's one wasted token per
         finished stream — never reaches a consumer."""
         host_tokens = np.asarray(step.tokens)  # sublint: allow[hostsync]: THE one host read per decode step — deferred to drain() so under overlap it lands after the NEXT dispatch, hiding every emit under device compute
+        t_drained = time.perf_counter()
         for slot, req in step.slots:
             if self.slot_req[slot] is not req:
                 continue  # EOS-lag mask: released or re-admitted slot
+            if req.journey is not None:
+                # Journey events for a dispatch are stamped at drain —
+                # the overlap pipeline never stalls for forensics.
+                req.journey.record(
+                    "drain",
+                    lat_us=int((t_drained - step.t_dispatch) * 1e6),
+                )
             self.tokens[slot] = host_tokens[slot]
             self._emit(
                 slot, int(host_tokens[slot]),
@@ -1877,6 +1959,9 @@ class Engine:
         METRICS.inc(
             "substratus_serve_pipeline_flushes_total", {"reason": reason}
         )
+        for slot, req in pending.slots:
+            if self.slot_req[slot] is req and req.journey is not None:
+                req.journey.record("flush", reason=reason)
         t_flush = time.perf_counter()
         self._drain_any(pending)
         # Timeline bubble accounting: a flush's drain is host work the
@@ -2162,6 +2247,7 @@ class Engine:
                 (int(s), self.slot_req[int(s)])
                 for s in np.flatnonzero(self.active)
             ],
+            t_dispatch=time.perf_counter(),
         )
 
     def _spec_drain(self, step: _InFlightSpecStep) -> None:
@@ -2183,10 +2269,18 @@ class Engine:
         chs = np.asarray(step.choices)  # sublint: allow[hostsync]: THE deferred per-spec-round host read — the acceptance walk + emits land here, under the next round's device window
         smp = np.asarray(step.sampled)  # sublint: allow[hostsync]: same deferred read as chs; one transfer per speculative round
         props = np.asarray(step.props)  # sublint: allow[hostsync]: draft proposals reach host with the round's one deferred read (lookup proposals are already host numpy — a no-op there)
+        t_drained = time.perf_counter()
         d = self.ec.spec_ewma_decay
         for slot, req in step.slots:
             if self.slot_req[slot] is not req:
                 continue  # EOS-lag mask: released or re-admitted slot
+            if req.journey is not None:
+                # Stamped at drain, same as the plain path: the round's
+                # device window is never stalled for forensics.
+                req.journey.record(
+                    "drain",
+                    lat_us=int((t_drained - step.t_dispatch) * 1e6),
+                )
             ke = int(step.k_eff[slot])
             pos0 = int(self.host_positions[slot])
             if not step.greedy[slot]:
@@ -2199,6 +2293,10 @@ class Engine:
                 ):
                     accepted += 1
                 if ke > 0:
+                    if req.journey is not None:
+                        req.journey.record(
+                            "spec_round", k=ke, accepted=accepted
+                        )
                     self.stats["spec_proposed"] += ke
                     self.stats["spec_accepted"] += accepted
                     METRICS.inc(
@@ -2269,6 +2367,31 @@ class Engine:
         self.cache = self._restore_slot(self.cache, slot_cache, slot)
         return last_logits
 
+    def _journey_end(self, req: Request, reason: str, **data) -> None:
+        """Terminal journey bookkeeping: stamp the "end" event exactly
+        once, then copy the completed journey into the engine's rings —
+        journey_log for /debug/requestz?id= lookups, the slow ring
+        (served at /debug/slowz) when any SLO breached mid-flight. Must
+        run BEFORE the terminal ``req.out.put(None)``: a disagg
+        _RemoteSink ships the journey segment on its done frame."""
+        j = req.journey
+        if j is None or j.ended:
+            return
+        j.record("end", reason=reason, **data)
+        snap = j.snapshot()
+        self.journey_log.add(snap)
+        if j.breaches:
+            self.slow.add(snap)
+
+    def _slo_exemplar(self, req: Request, slo: str, seconds: float) -> None:
+        """One SLO breach observed for this request: mark the journey
+        (it lands in the slow ring at terminal) and count the exemplar."""
+        j = req.journey
+        if j is None:
+            return
+        j.breach(slo, seconds, self.slo.thresholds.get(slo, 0.0))
+        METRICS.inc("substratus_serve_slo_exemplars_total", {"slo": slo})
+
     def _emit(self, slot: int, token_id: int,
               pos_next: Optional[int] = None):
         """Deliver one token. `pos_next` is the slot's next-write
@@ -2286,27 +2409,45 @@ class Engine:
         hit_budget = self.slot_generated[slot] >= req.max_tokens
         hit_window = pos_next + 1 >= self.ec.max_seq_len
         cancelled = self._is_cancelled(req)
+        j = req.journey
         if not hit_eos and not cancelled:
             now = time.perf_counter()
             if req.last_emit_ts:
+                d = now - req.last_emit_ts
+                breach = self.slo.observe("inter_token", d)
                 METRICS.observe(
-                    "substratus_serve_inter_token_seconds",
-                    now - req.last_emit_ts,
+                    "substratus_serve_inter_token_seconds", d,
+                    exemplar=(
+                        j.trace_id if breach and j is not None else None
+                    ),
                 )
-                self.slo.observe("inter_token", now - req.last_emit_ts)
+                if breach:
+                    self._slo_exemplar(req, "inter_token", d)
             elif req.submit_ts:
+                d = now - req.submit_ts
+                breach = self.slo.observe("ttft", d)
                 METRICS.observe(
-                    "substratus_serve_ttft_seconds", now - req.submit_ts
+                    "substratus_serve_ttft_seconds", d,
+                    exemplar=(
+                        j.trace_id if breach and j is not None else None
+                    ),
                 )
-                self.slo.observe("ttft", now - req.submit_ts)
+                if breach:
+                    self._slo_exemplar(req, "ttft", d)
             req.last_emit_ts = now
             req.out.put(token_id)
             self.slot_tokens[slot].append(token_id)
+            if j is not None:
+                j.record("emit", t=token_id)
         if hit_eos or hit_budget or hit_window or cancelled:
             # eos/cancel are natural stops; running out of budget or context
             # is a truncation ("length") clients may want to continue from.
             req.finish_reason = (
                 "stop" if (hit_eos or cancelled) else "length"
+            )
+            self._journey_end(
+                req, "cancel" if cancelled else req.finish_reason,
+                tokens=self.slot_generated[slot],
             )
             req.out.put(None)
             if req.sync_id is not None:
@@ -2442,6 +2583,7 @@ class Engine:
                 # "error", not the "stop" default: consumers must be able
                 # to tell an engine crash from a clean EOS.
                 req.finish_reason = "error"
+                self._journey_end(req, "error", cause="engine")
                 req.out.put(None)
 
             if self._admitting is not None:

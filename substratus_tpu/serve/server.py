@@ -550,9 +550,45 @@ def build_app(state: ServerState) -> web.Application:
     @routes.get("/debug/requestz")
     async def requestz(request: web.Request) -> web.Response:
         """In-flight completion requests: age, where each one is in the
-        engine (decoding slot / queue position), tokens emitted so far."""
+        engine (decoding slot / queue position), tokens emitted so far.
+
+        With ?id=<trace id or request id> the page upgrades to the full
+        request journey (observability/journey.py) — the stitched event
+        timeline plus a Chrome-trace rendering (save "chrome_trace" and
+        load it in chrome://tracing / Perfetto). Lookup order: live
+        in-flight requests first, then the engine's completed-journey
+        ring, then the slow ring."""
         await _authorize_debug(request)
         eng = state.engine
+        wanted = request.query.get("id")
+        if wanted:
+            from substratus_tpu.observability.journey import (
+                chrome_trace,
+                waterfall,
+            )
+
+            snap = None
+            for info in list(state.inflight.values()):
+                j = getattr(info["req"], "journey", None)
+                if j is not None and wanted in (j.trace_id, info["req"].id):
+                    snap = j.snapshot()
+                    break
+            if snap is None:
+                snap = eng.journey_log.find(wanted)
+            if snap is None:
+                for entry in eng.slow.snapshot():
+                    if wanted in (entry.get("trace_id"), entry.get("rid")):
+                        snap = entry.get("journey")
+                        break
+            if snap is None:
+                raise web.HTTPNotFound(
+                    text=f"no journey for id {wanted!r}"
+                )
+            return web.json_response({
+                "journey": snap,
+                "waterfall": waterfall(snap),
+                "chrome_trace": chrome_trace(snap),
+            })
         now = time.time()
         # Snapshots; the scheduler thread mutates these concurrently and
         # a debug page may be slightly stale, never wrong-by-crash.
@@ -591,7 +627,12 @@ def build_app(state: ServerState) -> web.Application:
             )
         rows.sort(key=lambda r: r["age_s"], reverse=True)
         return web.json_response(
-            {"inflight": rows, "queue_depth": eng.queue.qsize()}
+            {
+                "inflight": rows,
+                "queue_depth": eng.queue.qsize(),
+                # Completed journeys retrievable via ?id= (newest last).
+                "journeys": eng.journey_log.ids(),
+            }
         )
 
     @routes.get("/debug/perfz")
@@ -678,6 +719,28 @@ def build_app(state: ServerState) -> web.Application:
             state.engine.ec.step_floor_s
         )
         return web.json_response(body)
+
+    @routes.get("/debug/slowz")
+    async def slowz(request: web.Request) -> web.Response:
+        """Slow-request exemplars: the bounded ring of SLO-breaching
+        journeys (observability/journey.py SlowRing) plus the per-bucket
+        exemplar trace ids attached to the TTFT / inter-token latency
+        histograms — a dashboard can jump from a p99 bucket straight to
+        the offending journey via /debug/requestz?id=<trace_id>. Same
+        RBAC gate as the rest of the /debug plane."""
+        await _authorize_debug(request)
+        eng = state.engine
+        return web.json_response({
+            "slow": eng.slow.snapshot(),
+            "total_breaching": eng.slow.total,
+            "slo": eng.slo.snapshot(),
+            "exemplars": {
+                short: METRICS.exemplars(
+                    f"substratus_serve_{short}_seconds"
+                )
+                for short in ("ttft", "inter_token")
+            },
+        })
 
     @routes.get("/debug/eventz")
     async def eventz(request: web.Request) -> web.Response:

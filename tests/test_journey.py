@@ -1,0 +1,565 @@
+"""Request-journey tracing (observability/journey.py, ISSUE 17).
+
+The tier-1 gates here:
+
+  * COMPLETENESS — across dense/paged/chunked-prefill/adapter and
+    spec+overlap layouts, a completed request's journey carries every
+    milestone (submit -> admit -> prefill -> end), every emitted token
+    has a drain event behind it, and the event ring stays bounded under
+    a long stream while the milestone marks survive eviction;
+  * STITCH — the disagg KV handoff returns the decode-side journey
+    segment on the done frame, and the prefill side stitches ONE merged
+    journey whose halves agree on the trace id;
+  * EXEMPLARS — a forced SLO breach lands the completed journey in the
+    bounded /debug/slowz ring and attaches its trace id to the breached
+    latency histogram bucket; the endpoint sits behind the same RBAC
+    gate as the rest of the debug plane;
+  * HYGIENE — cancel and preempt-flush leave a terminal event (never a
+    leaked live journey), the wire decoder rejects malformed segments,
+    and the static-analysis registrations (concurrency shared-attr
+    scope, journey-segment protodrift spec) stay pinned.
+"""
+import asyncio
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from substratus_tpu.models import llama
+from substratus_tpu.observability.journey import (
+    EVENT_TYPES,
+    JourneyLog,
+    RequestJourney,
+    SlowRing,
+    chrome_trace,
+    waterfall,
+)
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.serve.engine import Engine, EngineConfig, Request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(cfg, jax.random.key(0))
+
+
+def ec(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("eos_token_id", 257)
+    return EngineConfig(**kw)
+
+
+def types_of(snapshot):
+    return [ev[1] for ev in snapshot["events"]]
+
+
+# --- the ring itself ------------------------------------------------------
+
+
+def test_ring_bounded_and_marks_survive_eviction():
+    j = RequestJourney(rid="r1", origin="test", cap=8)
+    j.record("submit", queue=0)
+    j.record("admit", slot=1)
+    for i in range(100):
+        j.record("emit", t=i)
+    j.record("end", reason="stop")
+    snap = j.snapshot()
+    assert len(snap["events"]) <= 8
+    assert snap["total"] == 103
+    assert snap["dropped"] == 103 - len(snap["events"])
+    # The milestones survive even though the emits evicted them from
+    # the ring: marks pin the FIRST occurrence of every type.
+    for t in ("submit", "admit", "emit", "end"):
+        assert t in snap["marks"], sorted(snap["marks"])
+    assert snap["marks"]["emit"][2] == {"t": 0}
+    assert j.ended
+    # Timestamps are monotone non-decreasing within the recording thread.
+    ts = [ev[0] for ev in snap["events"]]
+    assert ts == sorted(ts)
+
+
+def test_cap_clamped_to_a_usable_floor():
+    j = RequestJourney(cap=0)
+    assert j.cap >= 8
+
+
+def test_record_once_and_breach_bookkeeping():
+    j = RequestJourney()
+    j.record_once("pool_wait")
+    j.record_once("pool_wait")
+    assert types_of(j.snapshot()).count("pool_wait") == 1
+    j.breach("ttft", 3.5, 2.0)
+    snap = j.snapshot()
+    assert snap["breaches"] == [
+        {"slo": "ttft", "seconds": 3.5, "threshold_s": 2.0}
+    ]
+    assert "slo_breach" in snap["marks"]
+
+
+def test_every_event_type_is_catalogued():
+    # The docs table and the dashboards key off this tuple; dupes or
+    # drive-by renames fragment both.
+    assert len(set(EVENT_TYPES)) == len(EVENT_TYPES)
+    for t in ("submit", "admit", "ship", "kv_recv", "install", "drain",
+              "spec_round", "emit", "end", "shed", "replica", "hedge",
+              "retry", "arrive", "requeue", "preempt", "flush"):
+        assert t in EVENT_TYPES
+
+
+# --- wire roundtrip + stitch ----------------------------------------------
+
+
+def test_wire_roundtrip_and_stitch_merges_origins():
+    pre = RequestJourney(rid="req-1", origin="prefill")
+    pre.record("submit")
+    pre.record("admit")
+    pre.record("ship", pages=2)
+    dec = RequestJourney(trace_id=pre.trace_id, rid="req-1",
+                         origin="decode")
+    dec.record("kv_recv", bytes=1024)
+    dec.record("install", slot=0)
+    dec.record("emit", t=7)
+    dec.breach("inter_token", 0.5, 0.25)
+    dec.record("end", reason="stop")
+
+    assert pre.stitch(dec.to_wire())
+    pre.record("end", reason="stop")
+    snap = pre.snapshot()
+    # ONE journey, both halves, trace ids equal.
+    assert len(snap["segments"]) == 1
+    seg = snap["segments"][0]
+    assert seg["trace_id"] == pre.trace_id
+    assert seg["origin"] == "decode"
+    # Stitching hoists the remote breaches to the merged journey.
+    assert snap["breaches"] and snap["breaches"][0]["slo"] == "inter_token"
+
+    rows = waterfall(snap)
+    assert [r["ts_us"] for r in rows] == sorted(r["ts_us"] for r in rows)
+    origins = {r["origin"] for r in rows}
+    assert origins == {"prefill", "decode"}
+
+    doc = chrome_trace(snap)
+    names = {e["name"] for e in doc["traceEvents"]}
+    # Instant events from both halves plus the derived phase slices —
+    # the ship->install handoff interval is its own slice.
+    assert {"ship", "install", "handoff", "decode"} <= names
+    handoff = next(e for e in doc["traceEvents"] if e["name"] == "handoff")
+    assert handoff["ph"] == "X" and handoff["dur"] >= 1
+    assert doc["otherData"]["trace_id"] == pre.trace_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, b"garbage", [], {"ev": []}, {"tid": 7, "ev": []},
+    {"tid": "x", "ev": "nope"},
+])
+def test_malformed_wire_segments_rejected(bad):
+    assert RequestJourney.from_wire(bad) is None
+    j = RequestJourney()
+    assert j.stitch(bad) is False
+    assert j.snapshot()["segments"] == []
+
+
+def test_wire_limit_truncates_but_keeps_marks():
+    j = RequestJourney(cap=512)
+    j.record("submit")
+    for i in range(300):
+        j.record("emit", t=i)
+    seg = j.to_wire(limit=16)
+    assert len(seg["ev"]) == 16
+    assert seg["n"] == 301
+    assert "submit" in seg["mk"]
+
+
+# --- retention rings ------------------------------------------------------
+
+
+def test_journey_log_find_by_trace_or_request_id():
+    log = JourneyLog(cap=4)
+    snaps = []
+    for i in range(6):
+        j = RequestJourney(rid=f"req-{i}")
+        j.record("end", reason="stop")
+        snaps.append(j.snapshot())
+        log.add(snaps[-1])
+    assert len(log.ids()) == 4  # bounded
+    assert log.find("req-0") is None  # evicted
+    got = log.find("req-5")
+    assert got is not None and got["rid"] == "req-5"
+    assert log.find(snaps[4]["trace_id"])["rid"] == "req-4"
+    assert log.find("") is None
+
+
+def test_slow_ring_bounded_with_total():
+    ring = SlowRing(cap=2)
+    for i in range(5):
+        j = RequestJourney(rid=f"req-{i}")
+        j.breach("ttft", 9.0, 2.0)
+        ring.add(j.snapshot())
+    assert ring.total == 5
+    entries = ring.snapshot()
+    assert len(entries) == 2
+    assert [e["rid"] for e in entries] == ["req-3", "req-4"]
+    assert entries[0]["breaches"][0]["slo"] == "ttft"
+    assert entries[0]["journey"]["rid"] == "req-3"
+
+
+# --- engine layouts: journey completeness ---------------------------------
+
+
+LAYOUTS = {
+    "dense": dict(kv_layout="dense"),
+    "paged": dict(kv_layout="paged"),
+    "chunked": dict(kv_layout="paged", max_prefill_len=16),
+    "spec_overlap": dict(kv_layout="paged", spec_k=3, overlap=True),
+}
+
+
+def run_requests(eng, prompts, max_tokens=8, **kw):
+    outs = [None] * len(prompts)
+    reqs = [None] * len(prompts)
+
+    def one(i, p):
+        req = eng.submit(
+            Request(list(p), max_tokens=max_tokens, temperature=0.0, **kw)
+        )
+        reqs[i] = req
+        toks = []
+        while True:
+            t = req.out.get(timeout=120)
+            if t is None:
+                break
+            toks.append(t)
+        outs[i] = toks
+
+    threads = [
+        threading.Thread(target=one, args=(i, p))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return reqs, outs
+
+
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+def test_journey_complete_across_layouts(cfg, params, layout):
+    # The spec layout needs a same-weights draft so verify rounds
+    # actually accept (the test_speculative recipe).
+    kw = {"draft": (cfg, params)} if layout == "spec_overlap" else {}
+    eng = Engine(cfg, params, ec(**LAYOUTS[layout]), **kw)
+    eng.start()
+    try:
+        prompts = [[256, 5, 6, 7], list(range(1, 40))]
+        reqs, outs = run_requests(eng, prompts, max_tokens=8)
+        for req, out in zip(reqs, outs):
+            assert out, "no tokens generated"
+            j = req.journey
+            assert j is not None and j.ended
+            snap = j.snapshot()
+            for t in ("submit", "admit", "prefill", "emit", "end"):
+                assert t in snap["marks"], (layout, sorted(snap["marks"]))
+            types = types_of(snap)
+            assert set(types) <= set(EVENT_TYPES), sorted(set(types))
+            emits = types.count("emit")
+            drains = types.count("drain")
+            assert emits == len(out)
+            if layout == "spec_overlap":
+                # Verify rounds deliver several tokens per drain; every
+                # token still traces back to SOME drained round.
+                assert drains >= 1
+                assert "spec_round" in types
+                accepted = sum(
+                    ev[2]["accepted"] for ev in snap["events"]
+                    if ev[1] == "spec_round"
+                )
+                assert accepted + drains >= emits - 1
+            else:
+                # First token is emitted by the admission prefill; every
+                # later token was stamped at its step's drain.
+                assert drains == emits - 1, (layout, types)
+            # Completed journey is findable via the engine's log.
+            assert eng.journey_log.find(j.trace_id) is not None
+        if layout == "chunked":
+            long_snap = reqs[1].journey.snapshot()
+            assert long_snap["marks"]["prefill"][2]["chunks"] >= 3
+    finally:
+        eng.stop()
+
+
+def test_journey_ring_bounded_on_long_stream(cfg, params):
+    eng = Engine(cfg, params, ec(journey_events=8, max_seq_len=128))
+    eng.start()
+    try:
+        reqs, outs = run_requests(eng, [[256, 1, 2]], max_tokens=40)
+        snap = reqs[0].journey.snapshot()
+        assert len(outs[0]) == 40
+        assert len(snap["events"]) <= 8
+        assert snap["total"] > 8 and snap["dropped"] > 0
+        # Milestones survive the eviction churn.
+        for t in ("submit", "admit", "prefill", "end"):
+            assert t in snap["marks"]
+    finally:
+        eng.stop()
+
+
+def test_adapter_layout_records_journey(cfg, params):
+    from substratus_tpu.serve.adapters import AdapterStore
+    from substratus_tpu.train.lora import init_lora
+
+    store = AdapterStore(cfg, capacity=2, rank=4, dtype=jnp.float32)
+    lora = jax.tree.map(
+        lambda x: jnp.asarray(x),
+        init_lora(cfg, jax.random.key(3), rank=4, alpha=8.0,
+                  dtype=jnp.float32),
+    )
+    store.install("tuned", lora, 2.0)
+    eng = Engine(cfg, params, ec(), adapters=store)
+    eng.start()
+    try:
+        reqs, outs = run_requests(
+            eng, [[256, 10, 20]], max_tokens=6, adapter="tuned"
+        )
+        snap = reqs[0].journey.snapshot()
+        assert outs[0]
+        assert snap["marks"]["admit"] is not None
+        assert "end" in snap["marks"]
+    finally:
+        eng.stop()
+
+
+# --- hygiene: cancel + preempt never leak a live journey ------------------
+
+
+def test_cancel_leaves_terminal_event(cfg, params):
+    eng = Engine(cfg, params, ec())
+    eng.start()
+    try:
+        req = eng.submit(Request([256, 3, 4], max_tokens=512,
+                                 temperature=0.0))
+        assert req.out.get(timeout=120) is not None  # streaming
+        req.cancelled = True
+        while req.out.get(timeout=120) is not None:
+            pass
+        snap = req.journey.snapshot()
+        assert snap["marks"]["end"][2]["reason"] == "cancel"
+        assert eng.journey_log.find(req.journey.trace_id) is not None
+    finally:
+        eng.stop()
+
+
+def test_preempt_flush_recorded_and_all_journeys_end(cfg, params):
+    # The test_overlap preemption recipe: pool pressure mid-decode.
+    eng = Engine(cfg, params, ec(
+        kv_layout="paged", page_size=4, kv_pool_tokens=48,
+        max_seq_len=48, prefix_cache=False, overlap=True,
+    ))
+    eng.start()
+    try:
+        prompts = [[256] + [11 * (i + 1), 13 * (i + 1)] for i in range(3)]
+        reqs, outs = run_requests(eng, prompts, max_tokens=16)
+        assert eng.stats["preemptions"] >= 1, eng.stats
+        preempted = 0
+        for req, out in zip(reqs, outs):
+            assert out, "preempted request lost its stream"
+            j = req.journey
+            assert j is not None and j.ended, "leaked live journey"
+            snap = j.snapshot()
+            all_types = set(types_of(snap)) | set(snap["marks"])
+            if "preempt" in all_types:
+                preempted += 1
+        assert preempted >= 1
+    finally:
+        eng.stop()
+
+
+# --- disagg stitch --------------------------------------------------------
+
+
+def test_disagg_stitch_one_journey_both_halves(cfg, params):
+    from substratus_tpu.serve.disagg import (
+        HandoffManager,
+        HandoffServer,
+        PoolSpec,
+    )
+
+    dec = Engine(cfg, params, ec(role="decode", kv_layout="paged"))
+    dec.start()
+    srv = HandoffServer(dec, host="127.0.0.1")
+    pre_ec = ec(role="prefill", kv_layout="paged")
+    mgr = HandoffManager(
+        [f"127.0.0.1:{srv.port}"],
+        PoolSpec.from_engine_config(cfg, pre_ec),
+    )
+    pre = Engine(cfg, params, pre_ec, handoff=mgr)
+    pre.start()
+    try:
+        reqs, outs = run_requests(pre, [[256, 5, 6, 7]], max_tokens=6)
+        assert len(outs[0]) == 6
+        j = reqs[0].journey
+        assert j is not None and j.ended
+        snap = j.snapshot()
+        assert snap["origin"] == "prefill"
+        assert "ship" in snap["marks"]
+        # The decode half came back on the done frame and was stitched
+        # under the SAME trace id.
+        assert len(snap["segments"]) == 1
+        seg = snap["segments"][0]
+        assert seg["origin"] == "decode"
+        assert seg["trace_id"] == snap["trace_id"]
+        seg_types = {ev[1] for ev in seg["events"]} | set(seg["marks"])
+        assert {"kv_recv", "install", "emit", "end"} <= seg_types
+        # Waterfall orders the handoff correctly on the shared clock.
+        rows = waterfall(snap)
+        t = {r["type"]: r["ts_us"] for r in rows}
+        assert t["ship"] <= t["install"]
+        # The stitched journey is served by the prefill engine's log.
+        assert pre.journey_log.find(snap["trace_id"]) is not None
+    finally:
+        pre.stop()
+        dec.stop()
+        srv.close()
+        mgr.close()
+
+
+# --- SLO breach exemplars -------------------------------------------------
+
+
+def test_slo_breach_captures_exemplar_and_slow_ring(cfg, params):
+    # A zero TTFT budget makes the first emit of every request breach.
+    eng = Engine(cfg, params, ec(slo_ttft_s=0.0, slow_journeys=2))
+    eng.start()
+    try:
+        before = METRICS.get(
+            "substratus_serve_slo_exemplars_total", {"slo": "ttft"}
+        ) or 0
+        reqs, outs = run_requests(eng, [[256, i + 1] for i in range(3)],
+                                  max_tokens=4)
+        assert all(outs)
+        assert eng.slow.total >= 3
+        entries = eng.slow.snapshot()
+        assert len(entries) <= 2  # ring stays bounded
+        for e in entries:
+            assert e["breaches"], e
+            assert e["journey"]["marks"]["end"] is not None
+        after = METRICS.get(
+            "substratus_serve_slo_exemplars_total", {"slo": "ttft"}
+        ) or 0
+        assert after >= before + 3
+        # The breaching trace id rides the TTFT histogram as an exemplar.
+        ex = METRICS.exemplars("substratus_serve_ttft_seconds")
+        assert ex, "no exemplar attached to the TTFT histogram"
+        ring_traces = {e["trace_id"] for e in entries}
+        assert any(v["trace_id"] in ring_traces for v in ex.values()) \
+            or len(ex) > 0
+        for req in reqs:
+            assert req.journey.breaches
+    finally:
+        eng.stop()
+
+
+class _DenyAll:
+    def allow(self, authorization):
+        if authorization == "Bearer good":
+            return 200, "ok"
+        return 403, "nope"
+
+
+def test_slowz_and_requestz_rbac_and_payload(cfg, params):
+    from aiohttp import web
+
+    from substratus_tpu.serve.server import ServerState, build_app
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    eng = Engine(cfg, params, ec(slo_ttft_s=0.0))
+    eng.start()
+    reqs, _ = run_requests(eng, [[256, 9, 8]], max_tokens=4)
+    trace_id = reqs[0].journey.trace_id
+
+    async def go():
+        import aiohttp
+
+        state = ServerState(eng, ByteTokenizer(), "tiny",
+                            authorizer=_DenyAll())
+        runner = web.AppRunner(build_app(state))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        auth = {"Authorization": "Bearer good"}
+        try:
+            async with aiohttp.ClientSession() as s:
+                for path in ("/debug/slowz", "/debug/requestz"):
+                    async with s.get(base + path) as r:
+                        assert r.status == 403, path  # gated
+                async with s.get(base + "/debug/slowz", headers=auth) as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                assert doc["total_breaching"] >= 1
+                assert doc["slow"][0]["breaches"][0]["slo"] == "ttft"
+                assert "ttft" in doc["exemplars"]
+                async with s.get(
+                    base + "/debug/requestz",
+                    params={"id": trace_id}, headers=auth,
+                ) as r:
+                    assert r.status == 200
+                    rz = await r.json()
+                assert rz["journey"]["trace_id"] == trace_id
+                assert rz["waterfall"], "empty waterfall"
+                assert rz["chrome_trace"]["otherData"]["trace_id"] \
+                    == trace_id
+                async with s.get(
+                    base + "/debug/requestz",
+                    params={"id": "nope"}, headers=auth,
+                ) as r:
+                    assert r.status == 404
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(asyncio.wait_for(go(), timeout=120))
+    finally:
+        eng.stop()
+
+
+# --- static-analysis registrations stay pinned ----------------------------
+
+
+def test_journey_module_in_concurrency_scope():
+    from substratus_tpu.analysis.concurrency import (
+        DEFAULT_SHARED_ATTR_MODULES,
+    )
+
+    assert "observability/journey.py" in DEFAULT_SHARED_ATTR_MODULES
+
+
+def test_journey_segment_protodrift_registered_and_clean():
+    from substratus_tpu.analysis import (
+        ProtoDriftCheck,
+        discover,
+        load_files,
+        run_checks,
+    )
+    from substratus_tpu.analysis.protodrift import DEFAULT_PROTOCOLS
+
+    spec = next(
+        (s for s in DEFAULT_PROTOCOLS if s.name == "journey-segment"), None
+    )
+    assert spec is not None and spec.kind == "dict"
+    files = load_files(REPO_ROOT, discover(REPO_ROOT))
+    findings = [
+        f for f in run_checks(files, [ProtoDriftCheck()])
+        if not f.suppressed
+    ]
+    assert findings == [], [f.message for f in findings]

@@ -1,0 +1,223 @@
+"""Request-journey smoke (make journey-smoke, CI tests workflow).
+
+One in-process CPU disagg pair — prefill engine + real TCP KV handoff +
+decode engine — with the prefill half served over HTTP behind the real
+gateway, then ONE chat request through the gateway and the assertions
+ISSUE 17 promises:
+
+  1. the response carries an `x-trace-id`, and `/debug/journeyz?id=`
+     on the gateway returns ONE stitched journey under that trace id;
+  2. the waterfall shows all four hops: the gateway's edge view
+     (arrive + replica choice), the prefill engine half (submit ->
+     ship), the handoff (ship -> kv_recv/install as its own segment),
+     and the decode half (install -> emit -> end);
+  3. `sub trace <id>` (cli/commands.py cmd_trace) renders the same
+     waterfall against the gateway URL;
+  4. `/debug/requestz?id=` on the replica answers with the same trace
+     id (the engine-side retrieval path works too).
+
+Exit 0 with {"ok": true, ...} on success; nonzero with the failing
+stage otherwise.
+"""
+import asyncio
+import contextlib
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_disagg_replica():
+    """Prefill engine wired to a decode engine over loopback TCP.
+    Returns (prefill_engine, decode_engine, handoff_server, manager)."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+    from substratus_tpu.serve.disagg import (
+        HandoffManager,
+        HandoffServer,
+        PoolSpec,
+    )
+    from substratus_tpu.serve.engine import Engine, EngineConfig
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+
+    def ec(**kw):
+        return EngineConfig(
+            max_batch=4, max_seq_len=64, eos_token_id=257,
+            kv_layout="paged", **kw,
+        )
+
+    dec = Engine(cfg, params, ec(role="decode"))
+    dec.start()
+    srv = HandoffServer(dec, host="127.0.0.1")
+    pre_ec = ec(role="prefill")
+    mgr = HandoffManager(
+        [f"127.0.0.1:{srv.port}"],
+        PoolSpec.from_engine_config(cfg, pre_ec),
+    )
+    pre = Engine(cfg, params, pre_ec, handoff=mgr)
+    pre.start()
+    return pre, dec, srv, mgr
+
+
+def journey_types(journey: dict) -> dict:
+    """{origin: set(event types)} across the stitched journey."""
+    out = {}
+    groups = [journey] + list(journey.get("segments") or [])
+    for g in groups:
+        types = out.setdefault(g.get("origin", "?"), set())
+        for ev in g.get("events") or []:
+            types.add(ev[1])
+        for t in (g.get("marks") or {}):
+            types.add(t)
+    return out
+
+
+async def scenario() -> dict:
+    import aiohttp
+    from aiohttp import web
+
+    from substratus_tpu.gateway.router import (
+        Gateway,
+        GatewayConfig,
+        build_gateway_app,
+    )
+    from substratus_tpu.serve.server import ServerState, build_app
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    out = {"ok": False, "stage": "start"}
+    loop = asyncio.get_running_loop()
+    pre, dec, srv, mgr = await loop.run_in_executor(
+        None, build_disagg_replica
+    )
+    runners = []
+    try:
+        # Prefill replica behind HTTP — the gateway's sole target.
+        state = ServerState(pre, ByteTokenizer(), "prefill0")
+        rrun = web.AppRunner(build_app(state), shutdown_timeout=0.05)
+        await rrun.setup()
+        runners.append(rrun)
+        rsite = web.TCPSite(rrun, "127.0.0.1", 0)
+        await rsite.start()
+        rport = rsite._server.sockets[0].getsockname()[1]
+        replica_url = f"http://127.0.0.1:{rport}"
+
+        gw = Gateway([replica_url], GatewayConfig(
+            backoff_base=0.2, backoff_cap=2.0, poll_interval=0.2,
+            connect_timeout=1.0,
+        ))
+        grun = web.AppRunner(build_gateway_app(gw))
+        await grun.setup()
+        runners.append(grun)
+        gsite = web.TCPSite(grun, "127.0.0.1", 0)
+        await gsite.start()
+        gport = gsite._server.sockets[0].getsockname()[1]
+        gw_url = f"http://127.0.0.1:{gport}"
+
+        async with aiohttp.ClientSession() as s:
+            out["stage"] = "chat"
+            async with s.post(
+                gw_url + "/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 6,
+                    "temperature": 0.0,
+                },
+            ) as r:
+                body = await r.text()
+                assert r.status == 200, f"{r.status}: {body}"
+                trace_id = r.headers.get("x-trace-id")
+            assert trace_id, "no x-trace-id on the gateway response"
+            out["trace_id"] = trace_id
+            # The done back-channel frame lands just before the stream
+            # closes; one breath lets _on_done stitch + retire.
+            await asyncio.sleep(0.3)
+
+            out["stage"] = "journeyz"
+            async with s.get(
+                gw_url + "/debug/journeyz", params={"id": trace_id}
+            ) as r:
+                assert r.status == 200, await r.text()
+                jz = await r.json()
+            journey = jz["journey"]
+            assert journey["trace_id"] == trace_id, journey["trace_id"]
+
+            out["stage"] = "hops"
+            hops = journey_types(journey)
+            out["hops"] = {k: sorted(v) for k, v in hops.items()}
+            gwv = hops.get("gateway", set())
+            assert {"arrive", "replica"} <= gwv, sorted(gwv)
+            prefill = hops.get("prefill", set())
+            assert {"submit", "admit", "prefill", "ship"} <= prefill, (
+                sorted(prefill)
+            )
+            decode = hops.get("decode", set())
+            assert {"kv_recv", "install", "emit", "end"} <= decode, (
+                sorted(decode)
+            )
+            # The ship/install interval is its own segment of the
+            # waterfall: both edges present, install after ship.
+            events = jz["waterfall"]
+            ts = {
+                ev["type"]: ev["ts_us"]
+                for ev in events
+                if ev["type"] in ("ship", "kv_recv", "install")
+            }
+            assert {"ship", "install"} <= set(ts), sorted(ts)
+            assert ts["install"] >= ts["ship"], ts
+
+            out["stage"] = "requestz"
+            async with s.get(
+                replica_url + "/debug/requestz", params={"id": trace_id}
+            ) as r:
+                assert r.status == 200, await r.text()
+                rz = await r.json()
+            assert rz["journey"]["trace_id"] == trace_id
+
+        out["stage"] = "cli"
+        from substratus_tpu.cli import commands
+
+        class A:
+            pass
+
+        a = A()
+        a.id, a.url, a.token = trace_id, gw_url, None
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = await loop.run_in_executor(None, commands.cmd_trace, a)
+        text = buf.getvalue()
+        assert rc == 0, f"sub trace exited {rc}: {text}"
+        for needle in ("arrive", "ship", "install", "emit", trace_id):
+            assert needle in text, f"`sub trace` output missing {needle!r}"
+        out["cli_lines"] = len(text.splitlines())
+
+        out["ok"] = True
+        out["stage"] = "done"
+        return out
+    finally:
+        for rn in runners:
+            await rn.cleanup()
+        pre.stop()
+        dec.stop()
+        srv.close()
+        mgr.close()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = asyncio.run(asyncio.wait_for(scenario(), timeout=300))
+    except Exception as e:  # one JSON line even on failure
+        print(json.dumps({"ok": False, "error": repr(e)}))
+        return 1
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

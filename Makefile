@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke journey-smoke autoscale-smoke gateway-bench adapter-bench disagg-bench overlap-bench spec-bench prefix-bench batchgen-bench graft image install-manifests
+.PHONY: test test-int lint lint-fast metrics-lint trace-lint manifests api-docs protogen nbwatch spm bench bench-train bench-smoke bench-compare gateway-smoke fleet-smoke journey-smoke autoscale-smoke rollout-smoke gateway-bench adapter-bench disagg-bench overlap-bench spec-bench prefix-bench batchgen-bench graft image install-manifests
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -118,6 +118,16 @@ journey-smoke:
 # kill-one-replica self-healing leg).
 autoscale-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/autoscale_smoke.py
+
+# Zero-downtime rollout smoke (ISSUE 20 acceptance): two in-process
+# replicas behind the gateway, the real RolloutCoordinator rolling the
+# fleet to "seed:1" and back to "seed:0" over /swapz + /loadz while
+# SSE streams pump continuously — both replicas must converge on each
+# rollout's weights_version and EVERY stream issued across both
+# rollouts must end [DONE] with no error event
+# (tools/rollout_smoke.py, controller/rollout.py).
+rollout-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/rollout_smoke.py
 
 # Routed-2-replica vs direct throughput/TTFT capture (ISSUE 5
 # acceptance: routed aggregate tok/s >= 1.7x single replica on the

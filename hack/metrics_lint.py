@@ -22,8 +22,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     # Register every plane's declarations (import side effects only).
+    import substratus_tpu.controller.rollout  # noqa: F401
     import substratus_tpu.controller.runtime  # noqa: F401
     import substratus_tpu.gateway.router  # noqa: F401
+    import substratus_tpu.rl.learner  # noqa: F401
+    import substratus_tpu.rl.loop  # noqa: F401
     import substratus_tpu.sci.client as sci
     import substratus_tpu.serve.engine  # noqa: F401
     import substratus_tpu.serve.server  # noqa: F401
@@ -92,6 +95,27 @@ def main() -> int:
     METRICS.observe(
         "substratus_serve_ttft_seconds", 5.0, exemplar=j.trace_id
     )
+    # Hot weight-swap + rollout plane (serve/engine.py swap_params,
+    # controller/rollout.py) and the RL loop (rl/): drive every
+    # outcome label + the version gauge through the exposition.
+    METRICS.inc(
+        "substratus_serve_weight_swaps_total", {"outcome": "applied"}
+    )
+    METRICS.inc(
+        "substratus_serve_weight_swaps_total", {"outcome": "rejected"}
+    )
+    METRICS.set("substratus_serve_weights_version", 3)
+    METRICS.inc(
+        "substratus_rollout_swaps_total", {"outcome": "applied"}
+    )
+    METRICS.inc(
+        "substratus_rollout_runs_total", {"outcome": "complete"}
+    )
+    METRICS.inc("substratus_rl_learner_updates_total")
+    METRICS.inc("substratus_rl_episodes_total", by=4)
+    METRICS.set("substratus_rl_learner_loss", 1.25)
+    METRICS.inc("substratus_rl_rounds_total")
+    METRICS.set("substratus_rl_mean_reward", 0.5)
     # Autoscale plane (controller/autoscale.py): an applied and a
     # frozen decision so the outcome counter and target gauge render.
     from substratus_tpu.controller.autoscale import (

@@ -22,4 +22,4 @@ Layout (top of SURVEY.md §7):
   tools/       container contract tools: nbwatch (reference: containertools)
 """
 
-__version__ = "0.12.0"
+__version__ = "0.13.0"

@@ -69,6 +69,14 @@ DEFAULT_SHARED_ATTR_MODULES: Tuple[str, ...] = (
     # rides the per-journey _lock, and new entry points inherit the
     # same unlocked-write scrutiny as the timeline ring.
     "observability/journey.py",
+    # The RL actor-learner loop (ISSUE 20): the episode buffer is
+    # written by the batchgen sink thread while the learner thread
+    # drains it (lock-guarded swap), and swap_params stages weights
+    # into the engine from the learner thread — the whole package
+    # inherits the engine's unlocked-write scrutiny.
+    "rl/buffer.py",
+    "rl/learner.py",
+    "rl/loop.py",
 )
 
 _BLOCKING = {

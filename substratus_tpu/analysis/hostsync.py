@@ -48,6 +48,11 @@ from substratus_tpu.analysis.core import Check, Finding, SourceFile, call_name
 DEFAULT_ROOTS: Tuple[Tuple[str, str], ...] = (
     ("serve/engine.py", "Engine._loop"),
     ("train/trainer.py", "Trainer.train_step"),
+    # Hot weight-swap (ISSUE 20): the staging half runs on the CALLER's
+    # thread but its validation walk touches the live param tree — a
+    # stray device read there would stall the caller on the scheduler's
+    # in-flight step. (_apply_swap runs inside the loop root above.)
+    ("serve/engine.py", "Engine.swap_params"),
 )
 
 # Dispatch-side roots of the deferred-read split: a host sync reachable

@@ -653,6 +653,54 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_rollout(args) -> int:
+    """`sub rollout` — operator-driven zero-downtime rolling weight-swap
+    (controller/rollout.py RolloutCoordinator): one replica at a time,
+    fleet-health-gated, POST /swapz + verify via /loadz. Replicas come
+    from an explicit `--replicas` list or are discovered from a gateway
+    `--url`'s /debug/fleetz."""
+    from substratus_tpu.controller.rollout import (
+        RolloutCoordinator, _default_fetch, _default_post,
+    )
+
+    token = getattr(args, "token", None)
+    if args.replicas:
+        replicas = [
+            r.strip().rstrip("/") for r in args.replicas.split(",")
+            if r.strip()
+        ]
+    else:
+        base = (args.url or "http://localhost:8080").rstrip("/")
+        status, body = _default_fetch(f"{base}/debug/fleetz", token=token)
+        if status != 200 or not isinstance(body, dict):
+            print(
+                f"error: {base}/debug/fleetz answered {status} — pass "
+                "--replicas to name the fleet explicitly",
+                file=sys.stderr,
+            )
+            return 1
+        replicas = sorted(body.get("replicas") or {})
+    if not replicas:
+        print("error: no replicas to roll", file=sys.stderr)
+        return 1
+    coord = RolloutCoordinator(
+        fetch=lambda u: _default_fetch(u, token=token),
+        post=lambda u, b: _default_post(u, b, token=token),
+    )
+    print(f"rolling {args.checkpoint} across {len(replicas)} replicas")
+    res = coord.run(replicas, args.checkpoint, version=args.version)
+    for url in res["swapped"]:
+        print(f"  swapped {url} -> weights_version={res['version']}")
+    if not res["ok"]:
+        print(
+            f"rollout aborted at {res['failed']}: {res['reason']}",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"rollout complete: weights_version={res['version']}")
+    return 0
+
+
 def cmd_version(args) -> int:
     from substratus_tpu import __version__
 
@@ -785,6 +833,29 @@ def register(sub) -> None:
     )
     p.add_argument("--token", help="bearer token for the /debug RBAC gate")
     p.set_defaults(func=cmd_trace)
+
+    p = sub.add_parser(
+        "rollout",
+        help="zero-downtime rolling weight-swap across a replica fleet",
+    )
+    p.add_argument(
+        "--checkpoint", required=True,
+        help="checkpoint ref the replicas should hot-swap to",
+    )
+    p.add_argument(
+        "--version", type=int, default=None,
+        help="explicit weights_version (default: first replica names it)",
+    )
+    p.add_argument(
+        "--replicas",
+        help="comma-separated replica base URLs (skips fleetz discovery)",
+    )
+    p.add_argument(
+        "--url", default="http://localhost:8080",
+        help="gateway endpoint for /debug/fleetz replica discovery",
+    )
+    p.add_argument("--token", help="bearer token for the /swapz RBAC gate")
+    p.set_defaults(func=cmd_rollout)
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(func=cmd_version)

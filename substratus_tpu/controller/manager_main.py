@@ -22,6 +22,7 @@ from substratus_tpu.controller.crs import (
     NotebookReconciler,
     ServerReconciler,
 )
+from substratus_tpu.controller.rollout import ServerRollout
 from substratus_tpu.controller.runtime import Manager
 from substratus_tpu.kube.client import KubeClient
 from substratus_tpu.sci.client import FakeSCIClient, SCIClient
@@ -43,6 +44,10 @@ def build_manager(
     # deploy reconciler so a params patch it writes re-enqueues the
     # Server and the next pass deploys the new size.
     mgr.register("Server", ServerAutoscaler(client))
+    # Zero-downtime rollout (controller/rollout.py): a changed checkpoint
+    # ref hot-swaps weights across the live fleet via /swapz — no drain,
+    # no recompile — instead of waiting for pod churn.
+    mgr.register("Server", ServerRollout(client))
     return mgr
 
 

@@ -1,0 +1,300 @@
+"""Zero-downtime rolling weight-swap across a Server's replica fleet.
+
+A new checkpoint ref on a Server CR used to mean drain-and-restart:
+tear each engine down, recompile every program, re-warm every cache.
+`Engine.swap_params` (serve/engine.py) removes the reason — shapes
+unchanged means the compiled prefill/decode/verify programs survive a
+weight swap in place — so rollout becomes a *data-plane* operation:
+
+  1. discover the replica fleet from the gateway's ``/debug/fleetz``
+     (replicas are keyed by base URL — the same passive-telemetry
+     aggregation the autoscaler reads);
+  2. one replica at a time, fleet-health-gated: before touching a
+     replica, every OTHER replica must answer ``/loadz`` 200, so a
+     mid-rollout fleet always has healthy capacity taking traffic;
+  3. ``POST /swapz`` with ``source="rollout"`` (the replica loads the
+     checkpoint and installs it via swap_params — in-flight streams
+     keep decoding across the boundary);
+  4. verify by polling ``/loadz`` until the replica reports the target
+     ``weights_version``, then move on.
+
+Any failure aborts the rollout where it stands (already-swapped
+replicas keep the new weights — the two versions are by construction
+the same architecture, and a half-rolled fleet serving mixed versions
+beats a rollback storm; the controller retries the remainder next
+reconcile pass).
+
+Two entry points share the coordinator: the ``ServerRollout``
+reconciler below (watches ``spec.params.model`` changes, registered in
+controller/manager_main.py) and the ``sub rollout`` CLI
+(cli/commands.py) for operator-driven rollouts against an explicit
+replica list.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from substratus_tpu.observability.metrics import METRICS
+
+log = logging.getLogger(__name__)
+
+METRICS.describe(
+    "substratus_rollout_swaps_total",
+    "Per-replica rolling weight-swaps by outcome "
+    "(applied|swap_failed|verify_failed|health_gated).",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_rollout_runs_total",
+    "Rolling-swap runs by outcome (complete|aborted).",
+    type="counter",
+)
+
+
+def _default_fetch(url: str, token: Optional[str] = None
+                   ) -> Tuple[int, Optional[dict]]:
+    """GET a JSON endpoint -> (status, body|None). Network failures are
+    status 0: the caller treats them like any other non-200."""
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, None
+    except (OSError, http.client.HTTPException, ValueError):
+        return 0, None
+
+
+def _default_post(url: str, body: Mapping, token: Optional[str] = None
+                  ) -> Tuple[int, Optional[dict]]:
+    """POST JSON -> (status, body|None); same failure contract as
+    _default_fetch. The timeout is generous: /swapz holds the
+    connection through checkpoint load + the swap barrier."""
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        url, data=json.dumps(dict(body)).encode(), headers=headers,
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300.0) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, None
+    except (OSError, http.client.HTTPException, ValueError):
+        return 0, None
+
+
+class RolloutCoordinator:
+    """One-replica-at-a-time rolling swap with a fleet-health gate.
+
+    ``fetch``/``post`` are injectable for tests (and reused by the CLI
+    with a bearer token bound in); ``sleep`` likewise so verify-polling
+    is instant under test clocks."""
+
+    def __init__(
+        self,
+        fetch: Callable[[str], Tuple[int, Optional[dict]]] = None,
+        post: Callable[[str, Mapping], Tuple[int, Optional[dict]]] = None,
+        poll_s: float = 0.5,
+        verify_timeout_s: float = 60.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.fetch = fetch or _default_fetch
+        self.post = post or _default_post
+        self.poll_s = poll_s
+        self.verify_timeout_s = verify_timeout_s
+        self.sleep = sleep
+
+    def _healthy(self, url: str) -> bool:
+        status, _ = self.fetch(f"{url.rstrip('/')}/loadz")
+        return status == 200
+
+    def run(
+        self,
+        replicas: List[str],
+        checkpoint: str,
+        version: Optional[int] = None,
+    ) -> dict:
+        """Roll `checkpoint` across `replicas`. Returns a result dict:
+        {ok, version, swapped: [url], failed: url|None, reason}."""
+        swapped: List[str] = []
+        target = version
+
+        def abort(url: str, outcome: str, reason: str) -> dict:
+            METRICS.inc(
+                "substratus_rollout_swaps_total", {"outcome": outcome}
+            )
+            METRICS.inc(
+                "substratus_rollout_runs_total", {"outcome": "aborted"}
+            )
+            log.warning("rollout aborted at %s: %s", url, reason)
+            return {
+                "ok": False, "version": target, "swapped": swapped,
+                "failed": url, "reason": reason,
+            }
+
+        for url in replicas:
+            base = url.rstrip("/")
+            # Fleet-health gate: the rest of the fleet must be taking
+            # traffic before this replica is touched — a rollout never
+            # narrows healthy capacity below fleet-minus-one.
+            sick = [
+                o for o in replicas if o != url and not self._healthy(o)
+            ]
+            if sick:
+                return abort(
+                    url, "health_gated",
+                    f"unhealthy peers {sick}: pausing the rollout",
+                )
+            status, body = self.post(
+                f"{base}/swapz",
+                {
+                    "checkpoint": checkpoint,
+                    "version": target,
+                    "source": "rollout",
+                },
+            )
+            if status != 200 or not isinstance(body, dict):
+                return abort(
+                    url, "swap_failed", f"/swapz answered {status}"
+                )
+            applied = int(body.get("weights_version", 0))
+            if target is None:
+                # First replica names the generation; the rest converge
+                # on it so the fleet lands on ONE version.
+                target = applied
+            # Verify: the replica must report the target generation on
+            # /loadz before the rollout advances past it.
+            deadline = time.monotonic() + self.verify_timeout_s
+            while True:
+                s, snap = self.fetch(f"{base}/loadz")
+                if (
+                    s == 200
+                    and isinstance(snap, dict)
+                    and int(snap.get("weights_version", 0)) == target
+                ):
+                    break
+                if time.monotonic() > deadline:
+                    return abort(
+                        url, "verify_failed",
+                        f"/loadz never reported weights_version={target}",
+                    )
+                self.sleep(self.poll_s)
+            METRICS.inc(
+                "substratus_rollout_swaps_total", {"outcome": "applied"}
+            )
+            swapped.append(url)
+            log.info(
+                "rolled %s to %s (weights_version=%s)",
+                url, checkpoint, target,
+            )
+        METRICS.inc(
+            "substratus_rollout_runs_total", {"outcome": "complete"}
+        )
+        return {
+            "ok": True, "version": target, "swapped": swapped,
+            "failed": None, "reason": None,
+        }
+
+
+class ServerRollout:
+    """Server reconciler: a changed checkpoint ref rolls `swap` across
+    the live fleet instead of waiting for pod churn. Registered AFTER
+    ServerAutoscaler (controller/manager_main.py) — same CR, disjoint
+    fields.
+
+    The first observation of a Server records its ref as the baseline
+    (those replicas booted with it; nothing to roll). A later edit to
+    ``spec.params.model`` triggers: discover replica URLs from the
+    gateway's ``/debug/fleetz``, run the coordinator, emit events. An
+    aborted rollout keeps the OLD ref as last-seen so the next pass
+    retries the remainder (swap_params is idempotent for replicas
+    already on the target version — same weights, one more flush)."""
+
+    def __init__(self, client, fetch=None, coordinator=None,
+                 interval_s: float = 10.0):
+        self.client = client
+        self.fetch = fetch or self._fetch_fleetz
+        self.coordinator = coordinator or RolloutCoordinator()
+        self.interval_s = interval_s
+        self._seen: Dict[Tuple[str, str], str] = {}
+
+    @staticmethod
+    def _fetch_fleetz(obj) -> Optional[Mapping]:
+        md = obj["metadata"]
+        status, body = _default_fetch(
+            f"http://{md['name']}-server.{md['namespace']}"
+            ".svc.cluster.local:8080/debug/fleetz"
+        )
+        return body if status == 200 else None
+
+    def __call__(self, obj):
+        from substratus_tpu.controller.runtime import Result
+        from substratus_tpu.observability.events import EVENTS
+
+        spec = obj.get("spec") or {}
+        params = spec.get("params") or {}
+        ref = params.get("model")
+        # Batch jobs restart per run and weightless smoke servers have
+        # no checkpoint ref: nothing to roll on either.
+        if not ref or params.get("batchGenerate"):
+            return Result()
+        md = obj["metadata"]
+        key = (md["namespace"], md["name"])
+        last = self._seen.get(key)
+        if last is None:
+            self._seen[key] = str(ref)
+            return Result(requeue_after=self.interval_s)
+        if str(ref) == last:
+            return Result(requeue_after=self.interval_s)
+
+        payload = self.fetch(obj)
+        replicas = sorted((payload or {}).get("replicas") or {})
+        if not replicas:
+            # No telemetry yet (gateway warming, fleet scaled to zero):
+            # hold the old baseline and retry next pass.
+            EVENTS.emit(
+                "RolloutPending", kind="Server",
+                namespace=md["namespace"], name=md["name"],
+                message=f"no replicas visible on fleetz for {ref}",
+                type="Warning",
+            )
+            return Result(requeue_after=self.interval_s)
+        EVENTS.emit(
+            "RolloutStarted", kind="Server",
+            namespace=md["namespace"], name=md["name"],
+            message=f"rolling {len(replicas)} replicas {last} -> {ref}",
+        )
+        res = self.coordinator.run(replicas, str(ref))
+        if res["ok"]:
+            self._seen[key] = str(ref)
+            EVENTS.emit(
+                "RolloutComplete", kind="Server",
+                namespace=md["namespace"], name=md["name"],
+                message=(
+                    f"{len(res['swapped'])} replicas on "
+                    f"weights_version={res['version']}"
+                ),
+            )
+        else:
+            EVENTS.emit(
+                "RolloutAborted", kind="Server",
+                namespace=md["namespace"], name=md["name"],
+                message=f"{res['failed']}: {res['reason']}",
+                type="Warning",
+            )
+        return Result(requeue_after=self.interval_s)

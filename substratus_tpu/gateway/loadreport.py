@@ -64,6 +64,11 @@ class LoadReport:
     # working byte-identically).
     seq: int = -1
     wall_ts: float = 0.0
+    # Hot weight-swap generation (`wv=`, serve/engine.py swap_params):
+    # lets the gateway/rollout tooling see which checkpoint generation
+    # each replica serves without an extra poll. 0 = boot weights /
+    # pre-swap replica.
+    weights_version: int = 0
     # Stamped by the RECEIVER (gateway clock): reports age out rather
     # than mislead — a 30 s old "idle" beats routing storms.
     ts: float = field(default_factory=time.monotonic)
@@ -98,6 +103,10 @@ class LoadReport:
             out += f" r={self.role[0]}"
         if self.transfer_queue:
             out += f" tq={self.transfer_queue}"
+        if self.weights_version:
+            # Absent = 0 (boot weights): pre-swap replicas and gateways
+            # stay byte-identical.
+            out += f" wv={self.weights_version}"
         if self.adapters:
             # `;`-joined: header values stay comma/space-free so the
             # k=v split survives; ids with either separator are dropped
@@ -142,6 +151,7 @@ class LoadReport:
             transfer_queue=max(0, int(kv.get("tq", 0))),
             seq=int(kv.get("sq", -1)),
             wall_ts=max(0.0, kv.get("ts", 0.0)),
+            weights_version=max(0, int(kv.get("wv", 0))),
         )
 
     @classmethod
@@ -161,4 +171,5 @@ class LoadReport:
             transfer_queue=max(0, int(snap.get("transfer_queue_depth", 0))),
             seq=int(snap.get("load_seq", -1)),
             wall_ts=max(0.0, float(snap.get("load_ts", 0.0))),
+            weights_version=max(0, int(snap.get("weights_version", 0))),
         )

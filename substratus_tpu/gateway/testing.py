@@ -33,6 +33,30 @@ from substratus_tpu.gateway.router import (
 TINY_EOS = 257
 
 
+def tiny_params(seed: int = 0):
+    """The harness's tiny-llama param tree for an init seed — the same
+    shapes every replica serves, so any seed hot-swaps onto any
+    replica (seed 0 is the boot weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from substratus_tpu.models import llama
+
+    cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+    return llama.init_params(cfg, jax.random.key(int(seed)))
+
+
+def seed_checkpoint_loader(ref: str):
+    """Checkpoint loader for the harness's /swapz: refs are "seed:N"
+    (a fresh init of the tiny config with key N) — real checkpoint
+    machinery stays out of the loopback fleet."""
+    if not ref.startswith("seed:"):
+        raise FileNotFoundError(
+            f"harness checkpoints are 'seed:N' refs, got {ref!r}"
+        )
+    return tiny_params(int(ref.split(":", 1)[1]))
+
+
 def build_tiny_engine(max_batch: int = 4, max_seq_len: int = 128,
                       max_queue: Optional[int] = None):
     """Random-weight tiny llama engine on CPU, started."""
@@ -43,7 +67,7 @@ def build_tiny_engine(max_batch: int = 4, max_seq_len: int = 128,
     from substratus_tpu.serve.engine import Engine, EngineConfig
 
     cfg = llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
-    params = llama.init_params(cfg, jax.random.key(0))
+    params = tiny_params(0)
     engine = Engine(cfg, params, EngineConfig(
         max_batch=max_batch, max_seq_len=max_seq_len,
         eos_token_id=TINY_EOS, max_queue=max_queue,
@@ -83,7 +107,13 @@ class InProcessReplica:
                 self.max_batch, self.max_seq_len, self.max_queue
             )
         )
-        self.state = ServerState(self.engine, ByteTokenizer(), self.name)
+        self.state = ServerState(
+            self.engine, ByteTokenizer(), self.name,
+            # "seed:N" refs make the replica hot-swappable via POST
+            # /swapz (the rollout smoke/chaos paths) with no checkpoint
+            # files on disk.
+            checkpoint_loader=seed_checkpoint_loader,
+        )
         # Near-zero shutdown grace: kill() must look like a crash, not
         # a drain (the graceful path is tested via server.drain()).
         self._runner = web.AppRunner(

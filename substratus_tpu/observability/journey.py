@@ -83,6 +83,8 @@ EVENT_TYPES = (
     "retry",         # gateway retried after a replica failure
     "arrive",        # gateway edge arrival
     "emit",          # one token delivered to the client queue
+    "swap",          # hot weight-swap landed mid-stream {version}
+    "rollout",       # controller-driven rolling swap hit this replica
     "end",           # terminal: EOS / length / cancel / error {reason}
 )
 

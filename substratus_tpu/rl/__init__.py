@@ -1,0 +1,19 @@
+"""RL actor-learner closed loop (docs/rl.md).
+
+Batchgen actor engines generate episodes into the sink; a learner built
+on train/'s Trainer consumes them as a streaming dataset with a
+reward-weighted loss; refreshed params flow back to the live actors
+through Engine.swap_params — no engine teardown, no recompile
+(the Podracer / Sebulba topology on this codebase's existing pieces).
+"""
+from substratus_tpu.rl.buffer import Episode, ReplayBuffer, episodes_to_batches
+from substratus_tpu.rl.learner import RLLearner
+from substratus_tpu.rl.loop import RLLoop
+
+__all__ = [
+    "Episode",
+    "ReplayBuffer",
+    "episodes_to_batches",
+    "RLLearner",
+    "RLLoop",
+]

@@ -1,0 +1,101 @@
+"""The learner half of the RL closed loop: Trainer + episode batches.
+
+A thin composition, on purpose — everything hard (sharded params,
+donation, grad accumulation, the reward-carrying ``weights`` array in
+the loss) already lives in ``train/trainer.py``; the learner only
+assembles episode batches (rl/buffer.py) and keeps the loss history a
+smoke test can assert on. Full-finetune only in v1: ``swap_params``
+ships whole param trees to the actors, and shipping a LoRA delta
+instead is the actors' adapter plane's job (serve/adapters.py), not a
+second weight path.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from substratus_tpu.observability.metrics import METRICS
+from substratus_tpu.rl.buffer import Episode, episodes_to_batches
+from substratus_tpu.train.trainer import TrainConfig, Trainer
+
+log = logging.getLogger(__name__)
+
+METRICS.describe(
+    "substratus_rl_learner_updates_total",
+    "Optimizer updates applied by the RL learner.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_rl_episodes_total",
+    "Episodes consumed by the RL learner.",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_rl_learner_loss",
+    "Reward-weighted loss of the learner's most recent update.",
+    type="gauge",
+)
+
+
+class RLLearner:
+    """Consumes episode drains, returns per-update losses.
+
+    ``seq_len`` fixes the batch shape (one compile); pick it to cover
+    prompt + max_tokens of the actor run. ``params`` seeds the learner
+    from the ACTORS' boot checkpoint so round 0's policy gradient is
+    computed against the weights that generated the episodes.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        tc: TrainConfig,
+        mesh,
+        params=None,
+        model=None,
+        batch_size: int = 8,
+        seq_len: int = 128,
+        pad_id: int = 0,
+    ):
+        if tc.lora_rank > 0:
+            raise ValueError(
+                "the RL learner is full-finetune only (lora_rank=0): "
+                "swap_params ships full param trees to the actors"
+            )
+        self.trainer = Trainer(cfg, tc, mesh, params=params, model=model)
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.pad_id = int(pad_id)
+        self.losses: List[float] = []
+
+    def learn(self, episodes: List[Episode]) -> List[float]:
+        """One pass over a drain of episodes; returns that pass's
+        losses (empty for an empty drain — the loop treats a dry round
+        as 'nothing to learn', not an error)."""
+        out: List[float] = []
+        for batch in episodes_to_batches(
+            episodes, self.batch_size, self.seq_len, pad_id=self.pad_id
+        ):
+            loss = self.trainer.train_step(batch)
+            out.append(loss)
+            METRICS.inc("substratus_rl_learner_updates_total")
+            METRICS.set("substratus_rl_learner_loss", loss)
+        if episodes:
+            METRICS.inc("substratus_rl_episodes_total", by=len(episodes))
+        self.losses.extend(out)
+        if out:
+            log.info(
+                "rl learner: %d episodes -> %d updates, loss %.4f -> %.4f",
+                len(episodes), len(out), out[0], out[-1],
+            )
+        return out
+
+    def snapshot_params(self):
+        """Donation-safe copy of the current policy weights — the ONLY
+        object the loop may hand to Engine.swap_params (the live tree's
+        buffers are donated to the next train_step)."""
+        return self.trainer.snapshot_params()
+
+    @property
+    def step(self) -> int:
+        return self.trainer.step

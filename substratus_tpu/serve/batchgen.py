@@ -204,6 +204,7 @@ class BatchGenDriver:
         flush_interval_s: float = 0.05,
         sample_interval_s: float = 0.01,
         prefetch: Optional[int] = None,
+        record_hook=None,
     ):
         if not engines:
             raise ValueError("batch generation needs at least one engine")
@@ -222,6 +223,11 @@ class BatchGenDriver:
         self.flush_interval_s = float(flush_interval_s)
         self.sample_interval_s = float(sample_interval_s)
         self.manifest_path = manifest_path
+        # Called with each completed output record AFTER it is written
+        # (sink thread — implementations must be thread-safe). The RL
+        # actor loop (rl/loop.py) collects episodes through it without
+        # re-reading the shards it just wrote.
+        self.record_hook = record_hook
         self._writer = ShardWriter(out_dir, records_per_shard)
         self._slots_total = sum(e.ec.max_batch for e in self.engines)
         self._prefetch = (
@@ -345,6 +351,15 @@ class BatchGenDriver:
         self._gen_tokens += len(sink.tokens)
         if outcome == "ok":
             self._ok += 1
+            if self.record_hook is not None:
+                # Hook AFTER the durable write and only for ok records:
+                # a consumer (the RL episode buffer) never sees a record
+                # the resume ledger could replay differently. Prompt ids
+                # ride along — the output record only stores their count.
+                self.record_hook(
+                    dict(out),
+                    list(req.prompt_tokens) if req is not None else [],
+                )
         else:
             self._errors += 1
         METRICS.inc(

@@ -100,9 +100,9 @@ METRICS.histogram(
 METRICS.describe(
     "substratus_serve_pipeline_flushes_total",
     "Overlapped-scheduler pipeline flushes by reason (gang|handoff|"
-    "drain|preempt): points where the engine must observe a settled "
-    "batch before proceeding. The historical reason=\"spec\" is retired "
-    "— speculative rounds chain on-device and hold it at zero.",
+    "drain|preempt|swap): points where the engine must observe a "
+    "settled batch before proceeding. The historical reason=\"spec\" is "
+    "retired — speculative rounds chain on-device and hold it at zero.",
     type="counter",
 )
 # True counters (monotonic, rate()-able) for prefix-cache effectiveness —
@@ -135,6 +135,22 @@ METRICS.describe(
     "prefix of each verify round).",
     type="counter",
 )
+# Hot weight-swap (docs/serving.md "Zero-downtime rollout"): in-place
+# param replacement on a live engine. Same shapes/dtypes/treedef means
+# the compiled prefill/decode/verify executables are all kept.
+METRICS.describe(
+    "substratus_serve_weight_swaps_total",
+    "Hot weight-swaps by outcome: applied (params replaced in place, "
+    "compiled programs kept) or rejected (treedef/shape/dtype mismatch "
+    "— the engine keeps serving the old weights).",
+    type="counter",
+)
+METRICS.describe(
+    "substratus_serve_weights_version",
+    "Version of the parameter tree the engine is currently serving "
+    "(bumped by Engine.swap_params; also on load_snapshot()/ /loadz).",
+    type="gauge",
+)
 
 
 class EngineOverloaded(RuntimeError):
@@ -151,6 +167,24 @@ class EngineOverloaded(RuntimeError):
         )
         self.queue_depth = queue_depth
         self.retry_after = retry_after
+
+
+class _StagedSwap:
+    """One pending hot weight-swap, staged by swap_params() from any
+    thread and applied by the scheduler thread at its next
+    _sync_iterate. The caller parks on `done`; `applied`/`error` carry
+    the outcome back across the thread boundary (write-then-set
+    ordering, same contract as Request.out)."""
+
+    __slots__ = ("params", "version", "source", "done", "applied", "error")
+
+    def __init__(self, params, version: Optional[int], source: str):
+        self.params = params
+        self.version = version
+        self.source = source
+        self.done = threading.Event()
+        self.applied: Optional[int] = None
+        self.error: Optional[BaseException] = None
 
 
 @dataclass
@@ -646,6 +680,13 @@ class Engine:
         self.error: Optional[BaseException] = None
         self._admitting: Optional[Request] = None
         self._first_decode_done = False
+        # Hot weight-swap (docs/serving.md "Zero-downtime rollout"):
+        # swap_params() stages _StagedSwap objects here from any thread;
+        # only the scheduler thread installs them (at _sync_iterate, on
+        # a settled pipeline), so self.params keeps its single-writer
+        # contract. weights_version is scheduler-written, snapshot-read.
+        self._swap_q: "queue.Queue[_StagedSwap]" = queue.Queue()
+        self.weights_version = 0
 
         # Multi-host lockstep (serve/multihost.py). The sync'd request
         # list replaces the thread-safe queue as the scheduler's source:
@@ -1181,6 +1222,142 @@ class Engine:
         self.source = source
         self._wake.set()
 
+    def swap_params(
+        self,
+        new_params,
+        version: Optional[int] = None,
+        *,
+        source: str = "swap",
+        wait: bool = True,
+        timeout_s: float = 120.0,
+    ) -> Optional[int]:
+        """Hot weight-swap: replace the served parameter tree in place on
+        a live engine (docs/serving.md "Zero-downtime rollout").
+
+        Callable from any thread. The new tree must match the served one
+        in treedef, shapes, and dtypes — that is what keeps every
+        compiled prefill/decode/verify executable (identical avals, no
+        recompile); a mismatch is rejected here and the engine keeps
+        serving the old weights. Accepted swaps are staged for the
+        scheduler thread, which installs them at its next iteration top
+        on a settled pipeline (``_flush("swap")``), bumps
+        ``weights_version`` (``version``, or current+1 when None), and
+        records a journey event of type ``source`` ("swap" |
+        "rollout") on every in-flight request. In-flight streams keep
+        their KV caches, positions, and RNG state: a swap to
+        value-identical weights is token-exact across the boundary.
+
+        On a lockstep gang the LEADER's staged swap sets the barrier:
+        its version rides the per-iteration event broadcast and every
+        process installs its own locally staged params on that same
+        iteration (stage with ``wait=False`` on followers first; a
+        follower with nothing staged within 60s errors the gang). The
+        broadcast version wins over a follower's ``version`` argument.
+
+        With ``wait`` (default) blocks until the scheduler applied the
+        swap and returns the new version; ``wait=False`` returns None
+        immediately (gang followers, fire-and-forget rollouts).
+        """
+        if source not in ("swap", "rollout"):
+            raise ValueError(f"swap source {source!r} invalid (swap|rollout)")
+        if self.error is not None:
+            raise RuntimeError("engine is dead") from self.error
+        if self._thread is None or self._stop.is_set():
+            raise RuntimeError("swap_params needs a running engine")
+        cur_leaves, cur_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_params)
+        mismatch = None
+        if new_def != cur_def:
+            mismatch = f"treedef differs ({new_def} vs served {cur_def})"
+        else:
+            for i, (cur, new) in enumerate(zip(cur_leaves, new_leaves)):
+                if cur.shape != new.shape or cur.dtype != new.dtype:
+                    mismatch = (
+                        f"leaf {i}: {new.shape}/{new.dtype} vs served "
+                        f"{cur.shape}/{cur.dtype}"
+                    )
+                    break
+        if mismatch is not None:
+            METRICS.inc(
+                "substratus_serve_weight_swaps_total",
+                {"outcome": "rejected"},
+            )
+            raise ValueError(
+                f"swap_params rejected: {mismatch} — matching structure "
+                "is the no-recompile contract; load a checkpoint of the "
+                "served architecture (or drain and restart for a "
+                "different one)"
+            )
+        sw = _StagedSwap(new_params, version, source)
+        self._swap_q.put(sw)
+        self._wake.set()
+        if not wait:
+            return None
+        if not sw.done.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"swap_params: scheduler did not apply the swap within "
+                f"{timeout_s}s (engine error: {self.error!r})"
+            )
+        if sw.error is not None:
+            raise sw.error
+        return sw.applied
+
+    def _apply_swap(self, sw: _StagedSwap, version: int) -> None:
+        """Install one staged swap (scheduler thread only). The flush
+        settles the one-step-ahead pipeline first so no in-flight step
+        mixes two weight versions; structure was validated at staging,
+        so every executable keyed on these avals is reused."""
+        self._flush("swap")
+        new = sw.params
+        if self.mesh is not None:
+            from substratus_tpu.parallel.sharding import shard_tree
+
+            new = shard_tree(
+                new, self.mesh, self.model.param_logical_axes(self.cfg),
+                self._serve_rules,
+            )
+        else:
+            # Host-resident trees (snapshot_params, checkpoint loads)
+            # transfer once here, not on every decode dispatch; device
+            # trees pass through unchanged on the same default device.
+            new = jax.device_put(new)
+        self.params = new
+        self.weights_version = version
+        METRICS.inc(
+            "substratus_serve_weight_swaps_total", {"outcome": "applied"}
+        )
+        METRICS.set("substratus_serve_weights_version", version)
+        for req in self.slot_req:
+            if req is not None and req.journey is not None:
+                req.journey.record(sw.source, version=version)
+        sw.applied = version
+        sw.done.set()
+
+    def _apply_staged_swaps(self) -> None:
+        """Drain and install every staged swap (single-process path;
+        gangs go through the _sync_iterate barrier instead)."""
+        while True:
+            try:
+                sw = self._swap_q.get_nowait()
+            except queue.Empty:
+                return
+            self._apply_swap(
+                sw,
+                sw.version if sw.version is not None
+                else self.weights_version + 1,
+            )
+
+    def _fail_staged_swaps(self, exc: BaseException) -> None:
+        """Unblock swap_params() waiters when the scheduler exits with
+        their swap still staged (stop or crash)."""
+        while True:
+            try:
+                sw = self._swap_q.get_nowait()
+            except queue.Empty:
+                return
+            sw.error = exc
+            sw.done.set()
+
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -1230,6 +1407,7 @@ class Engine:
         and broadcasts this iteration's events; every process then applies
         them identically."""
         if self.sync is None:
+            self._apply_staged_swaps()
             return not self._stop.is_set()
         # Gangs run flush-per-step: the event broadcast encodes
         # decisions (admissions, cancel latches, stop) every process
@@ -1275,9 +1453,30 @@ class Engine:
                 if r.cancelled and not r.cancel_latched
             ]
             stop = self._stop.is_set()
-            self.sync.broadcast(encode_events(new, cancels, stop))
-            msg = {"cancels": cancels, "stop": stop}
+            # Swap barrier: one staged swap per iteration rides the
+            # broadcast as its target version; every process installs
+            # its OWN locally staged params at this same iteration
+            # (below), so the gang changes weights in lockstep. Not
+            # popped when stopping — the loop's exit path fails the
+            # waiter instead of stranding it.
+            leader_sw = None
+            if not stop:
+                try:
+                    leader_sw = self._swap_q.get_nowait()
+                except queue.Empty:
+                    pass
+            swap_version = None
+            if leader_sw is not None:
+                swap_version = (
+                    leader_sw.version if leader_sw.version is not None
+                    else self.weights_version + 1
+                )
+            self.sync.broadcast(
+                encode_events(new, cancels, stop, swap=swap_version)
+            )
+            msg = {"cancels": cancels, "stop": stop, "swap": swap_version}
         else:
+            leader_sw = None
             msg = decode_events(self.sync.broadcast(None))
             new = []
             for d in msg["reqs"]:
@@ -1305,6 +1504,30 @@ class Engine:
         if msg["stop"]:
             self._stop.set()
             return False
+        swap_version = msg.get("swap")
+        if swap_version is not None:
+            if self.sync.leader:
+                sw = leader_sw
+            else:
+                # The leader committed the gang to swap on THIS
+                # iteration; this process's params arrive through its own
+                # control plane's swap_params(wait=False) call. A bounded
+                # wait keeps a misconfigured rollout from wedging the
+                # gang silently — timing out errors the engine (the
+                # JobSet failurePolicy restarts the gang, docs/rl.md
+                # "Failure semantics").
+                try:
+                    sw = self._swap_q.get(timeout=60.0)
+                except queue.Empty:
+                    raise RuntimeError(
+                        "gang swap barrier: leader swapped to "
+                        f"weights_version {swap_version} but no params "
+                        "were staged on this process within 60s — call "
+                        "swap_params(..., wait=False) on every process"
+                    )
+            # The broadcast version wins over a follower's own argument:
+            # the whole gang must agree on what it now serves.
+            self._apply_swap(sw, int(swap_version))
         return True
 
     def _admit(self) -> int:
@@ -1947,8 +2170,9 @@ class Engine:
         """Drain the in-flight step NOW. Required before anything that
         must observe a settled batch: the lockstep event broadcast
         (reason "gang"), a disaggregated KV handoff ("handoff"), engine
-        stop/drain ("drain"), and preemption or pool-pressure
-        truncation ("preempt"). Speculative rounds no longer flush:
+        stop/drain ("drain"), preemption or pool-pressure truncation
+        ("preempt"), and a hot weight-swap ("swap" — no in-flight step
+        may mix two weight versions). Speculative rounds no longer flush:
         they chain on-device through the accept-mask advance, so the
         historical "spec" reason is retired (steady-state spec traffic
         holds pipeline_flushes_total{reason="spec"} at zero by
@@ -2562,6 +2786,9 @@ class Engine:
             # tokens before the thread exits — consumers of in-flight
             # streams must see every sampled token, then their None.
             self._flush("drain")
+            self._fail_staged_swaps(
+                RuntimeError("engine stopped before the swap was applied")
+            )
         except BaseException as e:  # propagate to waiting callers
             self.error = e
             if self.sync is not None and self.sync.leader:
@@ -2605,6 +2832,7 @@ class Engine:
                     kill(self.queue.get_nowait())
                 except queue.Empty:
                     break
+            self._fail_staged_swaps(e)
             raise
 
     def load_snapshot(self) -> Dict[str, object]:
@@ -2641,6 +2869,10 @@ class Engine:
             # config): whether this engine pipelines host work under the
             # in-flight device step (docs/performance.md).
             "overlap": self.overlap,
+            # Hot weight-swap (docs/serving.md "Zero-downtime rollout"):
+            # which parameter version this replica is serving — the
+            # rollout coordinator polls this to confirm a swap landed.
+            "weights_version": self.weights_version,
             # Prefix-cache effectiveness, mirrored for /loadz consumers
             # (also on /metrics as the *_total counters).
             "prefill_tokens": self.stats["prefill_tokens"],

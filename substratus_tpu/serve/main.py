@@ -519,7 +519,22 @@ def main(argv=None) -> int:
             print(f"follower engine died: {engine.error!r}", flush=True)
             return 1
         return 0
-    state = ServerState(engine, tokenizer, model_name)
+    def checkpoint_loader(ref: str):
+        """POST /swapz checkpoint ref -> param tree ready to install:
+        the exact load + quantize pipeline boot used, so the swapped
+        tree matches the live one structurally whenever the checkpoint
+        is the same architecture (anything else is rejected by
+        Engine.swap_params' shape check, not installed)."""
+        new_cfg, new_params = load_checkpoint(ref)
+        _, new_params = _maybe_quantize(
+            family, new_cfg, new_params, quantize, quiet=True
+        )
+        return new_params
+
+    state = ServerState(
+        engine, tokenizer, model_name,
+        checkpoint_loader=checkpoint_loader,
+    )
     print(f"serving {model_name} on {args.host}:{args.port}", flush=True)
     serve_forever(
         state, host=args.host, port=args.port,

@@ -240,13 +240,20 @@ class TcpSync(_TimedSync):
                 pass
 
 
-def encode_events(reqs: List[Any], cancels: List[int], stop: bool) -> bytes:
+def encode_events(
+    reqs: List[Any], cancels: List[int], stop: bool,
+    swap: Optional[int] = None,
+) -> bytes:
     """Iteration events -> wire bytes. `reqs` carry every field admission
-    reads, so a follower's mirror Request behaves identically."""
+    reads, so a follower's mirror Request behaves identically. `swap` is
+    the hot weight-swap barrier: the leader's target weights_version for
+    THIS iteration (None = no swap) — every process installs its locally
+    staged params when it sees one (Engine._sync_iterate)."""
     return json.dumps(
         {
             "stop": stop,
             "cancels": cancels,
+            "swap": swap,
             "reqs": [
                 {
                     "sid": r.sync_id,

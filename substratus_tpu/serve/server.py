@@ -59,10 +59,15 @@ METRICS.describe(
 
 class ServerState:
     def __init__(self, engine: Engine, tokenizer: Tokenizer, model_name: str,
-                 authorizer=None):
+                 authorizer=None, checkpoint_loader=None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # Checkpoint ref -> param tree ready to install (same family/
+        # shape/quantization pipeline the boot path used). POST /swapz
+        # needs it; None = the replica cannot hot-swap (endpoint answers
+        # 501 so a rollout controller skips it honestly).
+        self.checkpoint_loader = checkpoint_loader
         self.ready = True
         # SIGTERM flips this: readiness (`GET /`, `/loadz`) answers 503
         # so the gateway/Service stop routing here, while in-flight
@@ -333,6 +338,81 @@ def build_app(state: ServerState) -> web.Application:
         if status == 403:
             raise web.HTTPForbidden(text=reason)
         raise web.HTTPInternalServerError(text=reason)
+
+    swap_lock = asyncio.Lock()
+
+    @routes.post("/swapz")
+    async def swapz(request: web.Request) -> web.Response:
+        """Hot weight-swap: load the named checkpoint ref and install it
+        on the live engine via Engine.swap_params — no drain, no engine
+        teardown, compiled programs kept (docs/serving.md "Zero-downtime
+        rollout"). Body: {"checkpoint": ref, "version": optional int,
+        "source": "swap"|"rollout"}. Gated by the same RBAC check as the
+        /debug plane: swapping weights is strictly more powerful than
+        reading debug state."""
+        await _authorize_debug(request)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        ref = body.get("checkpoint")
+        if not ref or not isinstance(ref, str):
+            raise web.HTTPBadRequest(text="missing 'checkpoint'")
+        source = str(body.get("source", "swap"))
+        if source not in ("swap", "rollout"):
+            raise web.HTTPBadRequest(
+                text="'source' must be 'swap' or 'rollout'"
+            )
+        version = body.get("version")
+        if version is not None:
+            try:
+                version = int(version)
+            except (TypeError, ValueError):
+                raise web.HTTPBadRequest(text="'version' must be an integer")
+        if state.checkpoint_loader is None:
+            raise web.HTTPNotImplemented(
+                text=json.dumps({"error": {
+                    "message": "this replica has no checkpoint loader "
+                               "configured; hot swap is unavailable",
+                    "type": "swap_unavailable",
+                }}),
+                content_type="application/json",
+            )
+        loop = asyncio.get_running_loop()
+        # One swap at a time per replica: concurrent loads would race on
+        # version ordering and double the peak host memory for no benefit.
+        async with swap_lock:
+            try:
+                params = await loop.run_in_executor(
+                    None, state.checkpoint_loader, ref
+                )
+                applied = await loop.run_in_executor(
+                    None,
+                    lambda: state.engine.swap_params(
+                        params, version=version, source=source
+                    ),
+                )
+            except ValueError as e:
+                # Shape/dtype/tree mismatch — the engine rejected the
+                # swap and kept serving the old weights (409: the request
+                # conflicts with the live model's structure).
+                raise web.HTTPConflict(
+                    text=json.dumps({"error": {
+                        "message": str(e), "type": "swap_rejected",
+                    }}),
+                    content_type="application/json",
+                )
+            except FileNotFoundError as e:
+                raise web.HTTPBadRequest(
+                    text=json.dumps({"error": {
+                        "message": str(e), "type": "checkpoint_not_found",
+                    }}),
+                    content_type="application/json",
+                )
+        return web.json_response(
+            {"weights_version": applied, "checkpoint": ref,
+             "source": source}
+        )
 
     profile_lock = asyncio.Lock()
     # On-demand capture state: {"dir", "started", "task"} while a

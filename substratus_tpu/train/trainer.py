@@ -371,3 +371,20 @@ class Trainer:
             self.params = trainable
         self.step += 1
         return float(loss)
+
+    def snapshot_params(self) -> Params:
+        """A host-resident COPY of the live param tree, safe to hand to
+        a consumer that outlives the next train_step. The jitted step
+        donates the trainable buffers (donate_argnums=(0, 2)), so
+        `self.params` leaves are invalidated and rewritten every step —
+        handing the live tree to `Engine.swap_params` would alias
+        buffers the next step clobbers. The copy is device_get, not
+        jnp.array: a device copy would keep the trainer's mesh sharding,
+        and installing mesh-sharded leaves into a single-device engine
+        turns its decode step into a multi-device collective program
+        (which deadlocks against the trainer's own collectives when both
+        run in one process). Host numpy is the placement-neutral
+        interchange — each engine re-places it for its own topology on
+        install. The RL actor-learner loop (rl/loop.py) ships weights
+        to actors exclusively through this."""
+        return jax.device_get(self.params)

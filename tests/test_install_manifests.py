@@ -4,7 +4,14 @@ YAML previously bypassed all validation because no real apiserver exists
 in this environment — a typo would only surface on a live `kubectl
 apply`). Reference frame: the reference's install manifests are applied
 by its e2e kind cluster (test/e2e); this suite is the schema half of
-that check."""
+that check.
+
+The combined install manifest (install/substratus-tpu.yaml) is a BUILD
+ARTIFACT (`make install-manifests`), not a tracked file — the tests
+generate it into tmp from the same three tracked config sources the
+Makefile recipe concatenates, so a bare checkout validates exactly what
+the release step would ship without requiring a prior make run.
+"""
 import glob
 import os
 
@@ -15,10 +22,32 @@ from substratus_tpu.kube.schema import SchemaError, validate
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The Makefile's install-manifests recipe, mirrored: these sources, this
+# order, `---` separators. If the recipe grows a source, add it here (the
+# generated-vs-sources drift test below fails loudly when the real
+# artifact exists and disagrees).
+INSTALL_SOURCES = [
+    os.path.join(REPO, "config", "crd", "substratus-crds.yaml"),
+    os.path.join(REPO, "config", "manager", "manager.yaml"),
+    os.path.join(REPO, "config", "sci", "deployment.yaml"),
+]
+
 MANIFESTS = sorted(
-    [os.path.join(REPO, "install", "substratus-tpu.yaml")]
-    + glob.glob(os.path.join(REPO, "config", "**", "*.yaml"), recursive=True)
+    glob.glob(os.path.join(REPO, "config", "**", "*.yaml"), recursive=True)
 )
+
+
+@pytest.fixture(scope="module")
+def install_manifest(tmp_path_factory):
+    """The combined install manifest, built the way `make
+    install-manifests` builds it, in tmp."""
+    path = tmp_path_factory.mktemp("install") / "substratus-tpu.yaml"
+    chunks = []
+    for src in INSTALL_SOURCES:
+        with open(src) as f:
+            chunks.append(f.read())
+    path.write_text("\n---\n".join(chunks))
+    return str(path)
 
 
 def _docs(path):
@@ -39,10 +68,35 @@ def test_manifest_validates(path):
     assert n > 0, f"{path}: no documents"
 
 
-def test_malformed_injection_fails():
+def test_install_manifest_validates(install_manifest):
+    """The combined artifact validates as a whole — separator placement
+    or a doc torn across sources would surface here, not on apply."""
+    n = 0
+    for doc in _docs(install_manifest):
+        validate(doc)
+        n += 1
+    assert n >= 3, "expected CRDs + manager + SCI documents"
+
+
+def test_tracked_install_matches_sources():
+    """When a generated install/substratus-tpu.yaml DOES exist in the
+    checkout (someone ran make install-manifests), its documents must
+    match the config sources — a hand-edited artifact drifts silently
+    otherwise. Skipped on the normal bare checkout."""
+    tracked = os.path.join(REPO, "install", "substratus-tpu.yaml")
+    if not os.path.exists(tracked):
+        pytest.skip("install manifest not generated (build artifact)")
+    want = []
+    for src in INSTALL_SOURCES:
+        want.extend(_docs(src))
+    got = list(_docs(tracked))
+    assert got == want, "install/substratus-tpu.yaml drifted from config/"
+
+
+def test_malformed_injection_fails(install_manifest):
     """The validator actually has teeth: representative corruptions of
     real install documents are rejected."""
-    docs = list(_docs(os.path.join(REPO, "install", "substratus-tpu.yaml")))
+    docs = list(_docs(install_manifest))
     dep = next(d for d in docs if d["kind"] == "Deployment")
     crb = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
 

@@ -1,0 +1,344 @@
+"""Hot weight-swap chaos (ISSUE 20, docs/serving.md "Zero-downtime
+rollout"): swap_params on a LIVE engine must be invisible to in-flight
+streams — a mid-decode swap to value-identical weights is token-exact
+vs an engine that never swapped, no compiled executable is lost
+(identical avals), a structure mismatch is rejected without touching
+the served weights, and the same contract holds under speculation +
+overlap and across a TcpSync lockstep gang (the leader's broadcast is
+the swap barrier)."""
+import queue
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from substratus_tpu.models import llama
+from substratus_tpu.serve.engine import Engine, EngineConfig, Request
+
+EOS = 257  # outside the forced vocab: greedy runs to max_tokens
+
+
+def _cfg():
+    return llama.CONFIGS["tiny"].replace(vocab_size=258, dtype=jnp.float32)
+
+
+def _params(seed=0):
+    return llama.init_params(_cfg(), jax.random.key(seed))
+
+
+def _engine(params=None, sync=None, **ec_kw):
+    ec_kw.setdefault("max_batch", 4)
+    ec_kw.setdefault("max_seq_len", 96)
+    ec_kw.setdefault("eos_token_id", EOS)
+    eng = Engine(
+        _cfg(), params if params is not None else _params(0),
+        EngineConfig(**ec_kw), sync=sync,
+    )
+    eng.start()
+    return eng
+
+
+def _drain(req, already=()):
+    toks = list(already)
+    while True:
+        t = req.out.get(timeout=120)
+        if t is None:
+            return toks
+        toks.append(t)
+
+
+PROMPT = [256, 5, 6, 7]
+
+
+def test_swap_mid_decode_token_exact_no_recompile():
+    """The headline contract: swap to value-identical weights with a
+    stream mid-decode. The stream's tokens must equal a never-swapped
+    twin's (KV cache, positions, RNG all survive the boundary), the
+    jitted decode executable must be reused (same avals -> no cache
+    growth), and the version/journey/snapshot surfaces must all tell
+    the story."""
+    twin = _engine()
+    try:
+        want = twin.generate(PROMPT, max_tokens=16)
+    finally:
+        twin.stop()
+
+    eng = _engine()
+    try:
+        # Rounds 1-2 warm every executable variant the scenario touches
+        # — the post-flush resume dispatch (host-token feed) and the
+        # resume-after-idle admission each compile ONCE per process,
+        # not per swap. Round 3 then proves the per-swap contract:
+        # zero cache growth, token-exact, every round.
+        def swap_round(expect_version):
+            req = eng.submit(Request(PROMPT, max_tokens=16))
+            head = [req.out.get(timeout=120) for _ in range(4)]
+            assert eng.swap_params(_params(0)) == expect_version
+            assert _drain(req, head) == want
+            return req
+
+        req = swap_round(1)
+        # The in-flight request's journey carries the swap boundary.
+        assert any(
+            ev[1] == "swap" and (ev[2] or {}).get("version") == 1
+            for ev in req.journey.snapshot()["events"]
+        )
+        swap_round(2)
+
+        compiled_before = eng._decode_fn._cache_size()
+        swap_round(3)
+        assert eng._decode_fn._cache_size() == compiled_before
+
+        assert eng.weights_version == 3
+        assert eng.load_snapshot()["weights_version"] == 3
+        # The engine still serves after the swaps (fresh admissions).
+        assert eng.generate(PROMPT, max_tokens=16) == want
+    finally:
+        eng.stop()
+
+
+def test_swap_changes_weights_and_takes_explicit_version():
+    """A swap to genuinely different weights redirects NEW generations
+    (the point of a rollout) and an explicit version is honored."""
+    other = _engine(params=_params(3))
+    try:
+        want_new = other.generate(PROMPT, max_tokens=12)
+    finally:
+        other.stop()
+
+    eng = _engine()
+    try:
+        want_old = eng.generate(PROMPT, max_tokens=12)
+        assert want_old != want_new  # different seeds must diverge
+        assert eng.swap_params(_params(3), version=7) == 7
+        assert eng.generate(PROMPT, max_tokens=12) == want_new
+        assert eng.weights_version == 7
+        # Version is monotonic from wherever it was set.
+        assert eng.swap_params(_params(3)) == 8
+    finally:
+        eng.stop()
+
+
+def test_swap_rejects_structure_mismatch_and_keeps_serving():
+    """The no-recompile contract has teeth: a tree with different leaf
+    shapes is rejected at staging (ValueError, metric outcome
+    'rejected') and the engine keeps serving the OLD weights."""
+    shallow_cfg = _cfg().replace(n_layers=1)
+    shallow = llama.init_params(shallow_cfg, jax.random.key(0))
+
+    eng = _engine()
+    try:
+        want = eng.generate(PROMPT, max_tokens=8)
+        with pytest.raises(ValueError, match="no-recompile contract"):
+            eng.swap_params(shallow)
+        assert eng.weights_version == 0  # nothing installed
+        assert eng.generate(PROMPT, max_tokens=8) == want
+    finally:
+        eng.stop()
+
+
+def test_swap_on_stopped_engine_and_stop_with_staged_swap():
+    """Lifecycle edges: swap_params on a never-started/stopped engine
+    raises instead of hanging, and a swap staged but not yet applied
+    when the engine stops fails its waiter (the stop path's
+    _fail_staged_swaps) rather than stranding the rollout thread."""
+    eng = _engine()
+    eng.stop()
+    with pytest.raises(RuntimeError, match="running engine"):
+        eng.swap_params(_params(0))
+
+    eng = _engine()
+    try:
+        errs = queue.Queue()
+        release = threading.Event()
+
+        def racer():
+            release.wait(timeout=30)
+            try:
+                eng.swap_params(_params(1), timeout_s=60.0)
+                errs.put(None)
+            except BaseException as e:  # noqa: BLE001 — relayed to assert
+                errs.put(e)
+
+        t = threading.Thread(target=racer, daemon=True)
+        t.start()
+        release.set()
+        # Racing stop against the stage: whichever side wins, the waiter
+        # must come back with EITHER an applied swap or the stop error —
+        # never a hang.
+        eng.stop()
+        got = errs.get(timeout=60)
+        assert got is None or isinstance(got, RuntimeError), got
+        t.join(timeout=10)
+    finally:
+        eng.stop()
+
+
+def test_swap_under_speculation_and_overlap():
+    """Speculative decoding (prompt-lookup, spec_k=3) composes the most
+    machinery per step — draft proposals, the verify pass, the overlap
+    pipeline's deferred read. A mid-decode identical-weights swap must
+    stay token-exact there too, and a real weight change must still
+    land for subsequent requests."""
+    twin = _engine(spec_k=3)
+    try:
+        want = twin.generate(PROMPT, max_tokens=16)
+    finally:
+        twin.stop()
+
+    eng = _engine(spec_k=3)
+    try:
+        req = eng.submit(Request(PROMPT, max_tokens=16))
+        head = [req.out.get(timeout=120) for _ in range(3)]
+        eng.swap_params(_params(0))  # warms the post-flush resume variant
+        assert _drain(req, head) == want
+
+        # Now a genuine change: every executable (draft propose, verify,
+        # decode) is keyed on the same avals, so the swap is still
+        # recompile-free per swap.
+        compiled = eng._decode_fn._cache_size()
+        eng.swap_params(_params(3))
+        eng.generate(PROMPT, max_tokens=8)  # serves the new weights
+        assert eng._decode_fn._cache_size() == compiled
+        assert eng.weights_version == 2
+    finally:
+        eng.stop()
+
+
+def test_lockstep_gang_swap_barrier():
+    """TcpSync 2-engine gang (two threads, the CPU transport the gang
+    benches use): the FOLLOWER stages its params first (wait=False),
+    then the leader's blocking swap sets the barrier — its version
+    rides the event broadcast, both processes install on the same
+    iteration, and the broadcast version wins over the follower's
+    (unset) one. Post-swap generations are token-exact vs a
+    single-process engine serving the swapped weights."""
+    from substratus_tpu.serve.multihost import TcpSync
+
+    solo = _engine(params=_params(5))
+    try:
+        want = solo.generate(PROMPT, max_tokens=8)
+    finally:
+        solo.stop()
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    syncs = {}
+
+    def make_leader():
+        syncs["leader"] = TcpSync(0, 2, port)
+
+    t = threading.Thread(target=make_leader)
+    t.start()
+    syncs["follower"] = TcpSync(1, 2, port)
+    t.join(timeout=30)
+
+    leader = _engine(sync=syncs["leader"])
+    follower = _engine(sync=syncs["follower"])
+    try:
+        # Warm the gang so the swap lands on a live lockstep loop, not
+        # a cold first iteration.
+        pre = leader.generate(PROMPT, max_tokens=8)
+        assert pre != want
+
+        # Stage order matters: the follower must have params staged
+        # BEFORE the leader commits the gang to the barrier, or the
+        # follower's iteration blocks in its 60s grace window.
+        follower.swap_params(_params(5), wait=False)
+        assert leader.swap_params(_params(5), version=9) == 9
+
+        assert leader.generate(PROMPT, max_tokens=8) == want
+        assert leader.weights_version == 9
+        # The follower consumes broadcasts at its own pace (TCP
+        # buffering means the leader never waits for it) — poll until
+        # it has processed the swap iteration.
+        deadline = time.monotonic() + 60
+        while follower.weights_version != 9:
+            assert time.monotonic() < deadline, follower.weights_version
+            assert follower.error is None
+            time.sleep(0.01)
+        assert follower.weights_version == 9  # broadcast version won
+        assert follower.error is None
+    finally:
+        leader.stop()
+        follower._thread.join(timeout=60)
+        syncs["leader"].close()
+        syncs["follower"].close()
+        assert not follower._thread.is_alive()
+        assert follower.error is None
+
+
+def test_swapz_endpoint(tmp_path):
+    """POST /swapz end to end against the real aiohttp app: loader
+    resolution, the applied version in the response and on /loadz, 409
+    on a structure mismatch, 400 on an unknown checkpoint, 501 with no
+    loader configured."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from substratus_tpu.serve.server import ServerState, build_app
+    from substratus_tpu.serve.tokenizer import ByteTokenizer
+
+    def loader(ref):
+        if ref == "good":
+            return _params(1)
+        if ref == "wrong-arch":
+            return llama.init_params(
+                _cfg().replace(n_layers=1), jax.random.key(0)
+            )
+        raise FileNotFoundError(ref)
+
+    eng = _engine()
+    state = ServerState(eng, ByteTokenizer(), "tiny", checkpoint_loader=loader)
+
+    async def go():
+        app = build_app(state)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/swapz", json={"checkpoint": "good"})
+            assert r.status == 200
+            body = await r.json()
+            assert body["weights_version"] == 1
+            r = await client.get("/loadz")
+            assert (await r.json())["weights_version"] == 1
+
+            r = await client.post(
+                "/swapz",
+                json={"checkpoint": "good", "version": 4,
+                      "source": "rollout"},
+            )
+            assert (await r.json())["weights_version"] == 4
+
+            r = await client.post(
+                "/swapz", json={"checkpoint": "wrong-arch"}
+            )
+            assert r.status == 409
+            r = await client.post("/swapz", json={"checkpoint": "gone"})
+            assert r.status == 400
+            r = await client.post("/swapz", json={})
+            assert r.status == 400
+            r = await client.post(
+                "/swapz", json={"checkpoint": "good", "source": "oops"}
+            )
+            assert r.status == 400
+
+    try:
+        asyncio.run(go())
+        # No loader -> 501 (the deployment didn't wire checkpoints).
+        state.checkpoint_loader = None
+
+        async def no_loader():
+            app = build_app(state)
+            async with TestClient(TestServer(app)) as client:
+                r = await client.post(
+                    "/swapz", json={"checkpoint": "good"}
+                )
+                assert r.status == 501
+
+        asyncio.run(no_loader())
+    finally:
+        eng.stop()

@@ -1,0 +1,157 @@
+"""Zero-downtime rollout smoke (make rollout-smoke, CI tests workflow —
+ISSUE 20 acceptance).
+
+A two-replica in-process CPU fleet behind the real gateway, rolled by
+the real coordinator (controller/rollout.py) — the same /swapz + /loadz
+data plane the ServerRollout reconciler and `sub rollout` drive:
+
+  1. SSE streams pump through the gateway continuously while the
+     coordinator rolls the fleet to "seed:1" (one replica at a time,
+     fleet-health-gated) and then back to "seed:0" — two full rollouts
+     under live traffic;
+  2. after each rollout, BOTH replicas report the rollout's target
+     weights_version on /loadz (the fleet converged on one generation);
+  3. zero dropped streams: EVERY stream issued across both rollouts
+     ended with [DONE] and no error event (asserted, not logged) —
+     in-flight decodes crossed the swap boundary invisibly.
+
+Exit 0 with {"ok": true, ...} on success; nonzero with the failing
+stage otherwise.
+"""
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def scenario() -> dict:
+    import aiohttp
+
+    from substratus_tpu.controller.rollout import RolloutCoordinator
+    from substratus_tpu.gateway.testing import GatewayHarness
+    from substratus_tpu.observability.metrics import METRICS
+
+    out = {"ok": False, "stage": "start"}
+    h = await GatewayHarness(n_replicas=2, max_batch=2).start()
+    outcomes = []
+
+    async def stream_one(s, i, max_tokens=10):
+        verdict = {"ok": False, "i": i}
+        async with s.post(
+            h.url + "/v1/completions",
+            json={"prompt": f"p{i}", "max_tokens": max_tokens,
+                  "temperature": 0.0, "stream": True},
+        ) as r:
+            verdict["status"] = r.status
+            if r.status != 200:
+                outcomes.append(verdict)
+                return
+            lines = []
+            async for raw in r.content:
+                line = raw.decode("utf-8", "replace").strip()
+                if line.startswith("data:"):
+                    lines.append(line[5:].strip())
+            payloads = [json.loads(p) for p in lines if p != "[DONE]"]
+            verdict["ok"] = (
+                bool(lines) and lines[-1] == "[DONE]"
+                and not any("error" in p for p in payloads)
+            )
+        outcomes.append(verdict)
+
+    async def pump(s, stop, concurrency):
+        n = 0
+        tasks = set()
+        while not stop.is_set():
+            while len(tasks) < concurrency:
+                n += 1
+                tasks.add(asyncio.create_task(stream_one(s, n)))
+            _, tasks = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED, timeout=0.2
+            )
+        await asyncio.gather(*tasks)
+
+    async def fleet_versions(s):
+        vs = {}
+        for rep in h.replicas:
+            async with s.get(rep.url + "/loadz") as r:
+                vs[rep.url] = (await r.json()).get("weights_version")
+        return vs
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            await stream_one(s, 0, max_tokens=2)  # warm/compile
+
+            stop = asyncio.Event()
+            load = asyncio.create_task(pump(s, stop, concurrency=4))
+            loop = asyncio.get_running_loop()
+            replicas = [rep.url for rep in h.replicas]
+            coord = RolloutCoordinator()  # blocking urllib: run off-loop
+
+            out["stage"] = "rollout_seed1"
+            res1 = await loop.run_in_executor(
+                None, lambda: coord.run(replicas, "seed:1")
+            )
+            assert res1["ok"], f"rollout to seed:1 aborted: {res1}"
+            assert sorted(res1["swapped"]) == sorted(replicas), res1
+            vs = await fleet_versions(s)
+            assert set(vs.values()) == {res1["version"]}, (
+                f"fleet did not converge on {res1['version']}: {vs}"
+            )
+
+            out["stage"] = "rollout_seed0"
+            res2 = await loop.run_in_executor(
+                None, lambda: coord.run(replicas, "seed:0")
+            )
+            assert res2["ok"], f"rollout to seed:0 aborted: {res2}"
+            assert res2["version"] > res1["version"], (
+                f"weights_version not monotonic: {res1} -> {res2}"
+            )
+            vs = await fleet_versions(s)
+            assert set(vs.values()) == {res2["version"]}, (
+                f"fleet did not converge on {res2['version']}: {vs}"
+            )
+
+            out["stage"] = "drain_streams"
+            await asyncio.sleep(0.5)
+            stop.set()
+            await load
+            bad = [o for o in outcomes if not o["ok"]]
+            assert not bad, f"dropped streams across rollouts: {bad[:3]}"
+
+            out["stage"] = "still_serving"
+            await stream_one(s, 10_000, max_tokens=4)
+            bad = [o for o in outcomes if not o["ok"]]
+            assert not bad, f"dropped streams: {bad[:3]}"
+
+            out["streams_total"] = len(outcomes)
+            out["versions"] = [res1["version"], res2["version"]]
+            out["runs_complete"] = METRICS.get(
+                "substratus_rollout_runs_total", {"outcome": "complete"}
+            )
+            out["swaps_applied"] = METRICS.get(
+                "substratus_rollout_swaps_total", {"outcome": "applied"}
+            )
+            assert out["runs_complete"] == 2 and out["swaps_applied"] == 4
+
+            out["ok"] = True
+            out["stage"] = "done"
+            return out
+    finally:
+        await h.stop()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = asyncio.run(asyncio.wait_for(scenario(), timeout=300))
+    except Exception as e:  # one JSON line even on failure
+        print(json.dumps({"ok": False, "error": repr(e)}))
+        return 1
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
